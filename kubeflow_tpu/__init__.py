@@ -1,0 +1,28 @@
+"""kubeflow_tpu: a TPU-native ML platform.
+
+A from-scratch framework with the capabilities of the Kubeflow GPU execution
+plane (training-job orchestration, model serving, hyperparameter tuning,
+pipelines), re-designed TPU-first:
+
+- ``core``        — device mesh / ICI+DCN topology, ``jax.distributed``
+                    bootstrap, collective helpers.
+- ``orchestrator``— the JAXJob control plane: declarative job specs with
+                    ReplicaSpec/RunPolicy/gang-scheduling semantics, a
+                    reconciler engine, and a process-gang launcher.
+- ``train``       — SPMD training loop, Orbax checkpointing, metric writers.
+- ``models``      — flax model zoo (MNIST CNN, ResNet, BERT, TransformerLM, MoE).
+- ``parallel``    — DP/FSDP/TP/PP/SP(Ulysses)/CP(ring attention)/EP as named
+                    mesh axes.
+- ``ops``         — Pallas TPU kernels (flash attention, ring attention, ...).
+- ``serve``       — TPUPredictor model server (KServe-equivalent data plane).
+- ``tune``        — hyperparameter tuning (Katib-equivalent).
+- ``pipelines``   — DAG pipelines (KFP-equivalent).
+- ``obs``         — profiling, metrics, failure supervision.
+
+Scope and semantics follow ``SURVEY.md`` (structural analysis of the
+zxhx/kubeflow reference); the reference mount was empty at survey and build
+time (SURVEY.md §0), so reference citations in docstrings use the upstream
+Kubeflow layout and are tagged UNVERIFIED.
+"""
+
+__version__ = "0.1.0"

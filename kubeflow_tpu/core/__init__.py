@@ -1,0 +1,12 @@
+"""Runtime + communication foundation: mesh, distributed bootstrap, collectives."""
+
+from kubeflow_tpu.core.mesh import (  # noqa: F401
+    Axis,
+    MeshSpec,
+    build_mesh,
+    slice_topology,
+)
+from kubeflow_tpu.core.distributed import (  # noqa: F401
+    DistributedConfig,
+    initialize_from_env,
+)

@@ -1,0 +1,148 @@
+"""``jax.distributed`` bootstrap from the orchestrator's env contract.
+
+TPU-native replacement for the reference's rank-rendezvous wiring
+(SURVEY.md §2.7): where the PyTorchJob controller sets
+``MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE`` (c10d TCPStore rendezvous) and the
+TFJob controller builds ``TF_CONFIG`` JSON, our JAXJob controller sets three
+env vars and worker processes call :func:`initialize_from_env` exactly once
+before touching any device.
+
+Env contract (written by ``kubeflow_tpu.orchestrator.envwire``):
+
+- ``JAX_COORDINATOR_ADDRESS``  — host:port of process 0 (the "master" headless
+  service analog).
+- ``JAX_NUM_PROCESSES``        — world size.
+- ``JAX_PROCESS_ID``           — this pod's completion-index / rank.
+- ``JAX_LOCAL_DEVICE_IDS``     — optional, comma-separated; used by CPU
+  simulation so each process claims distinct virtual devices.
+
+Reference analog (UNVERIFIED upstream layout, mount empty — SURVEY.md §0):
+[training-operator] pkg/controller.v1/pytorch/envvar.go (setPodEnv),
+pkg/controller.v1/tensorflow/tfjob_controller.go (TF_CONFIG builder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+ENV_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_LOCAL_DEVICE_IDS = "JAX_LOCAL_DEVICE_IDS"
+
+# GKE TPU provisioning surface the orchestrator models (SURVEY.md §5.8).
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Resolved multi-process rendezvous parameters."""
+
+    coordinator_address: str | None = None
+    num_processes: int = 1
+    process_id: int = 0
+    local_device_ids: tuple[int, ...] | None = None
+
+    @property
+    def is_multiprocess(self) -> bool:
+        return self.num_processes > 1
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "DistributedConfig":
+        """Resolve from the JAXJob env contract, with TPU-pod fallbacks.
+
+        Precedence: explicit ``JAX_*`` contract > GKE ``TPU_WORKER_*`` vars
+        (a bare TPU pod slice without our orchestrator) > single-process.
+        """
+        e = os.environ if env is None else env
+        if ENV_NUM_PROCESSES in e:
+            num = int(e[ENV_NUM_PROCESSES])
+            cfg = cls(
+                coordinator_address=e.get(ENV_COORDINATOR_ADDRESS),
+                num_processes=num,
+                process_id=int(e.get(ENV_PROCESS_ID, "0")),
+                local_device_ids=_parse_device_ids(e.get(ENV_LOCAL_DEVICE_IDS)),
+            )
+        elif ENV_TPU_WORKER_HOSTNAMES in e:
+            hosts = [h for h in e[ENV_TPU_WORKER_HOSTNAMES].split(",") if h]
+            if len(hosts) > 1 and ENV_TPU_WORKER_ID not in e:
+                raise ValueError(
+                    f"{ENV_TPU_WORKER_HOSTNAMES} lists {len(hosts)} workers "
+                    f"but {ENV_TPU_WORKER_ID} is unset; every worker would "
+                    "claim rank 0"
+                )
+            cfg = cls(
+                coordinator_address=f"{hosts[0]}:8476" if hosts else None,
+                num_processes=max(len(hosts), 1),
+                process_id=int(e.get(ENV_TPU_WORKER_ID, "0")),
+            )
+        else:
+            cfg = cls()
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.num_processes < 1:
+            raise ValueError(f"num_processes must be >=1, got {self.num_processes}")
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(
+                f"process_id {self.process_id} out of range "
+                f"[0, {self.num_processes})"
+            )
+        if self.is_multiprocess and not self.coordinator_address:
+            raise ValueError(
+                f"{ENV_COORDINATOR_ADDRESS} required when "
+                f"{ENV_NUM_PROCESSES} > 1"
+            )
+
+
+def initialize(cfg: DistributedConfig) -> None:
+    """Idempotently bring up the ``jax.distributed`` coordinator/clients.
+
+    The coordinator service (gRPC, C++ inside jaxlib) is the c10d-TCPStore /
+    MPI-rendezvous equivalent; it also provides the peer-failure detection the
+    supervisor relies on (SURVEY.md §5.3).
+    """
+    global _initialized
+    if _initialized:
+        logger.debug("jax.distributed already initialized; skipping")
+        return
+    if not cfg.is_multiprocess:
+        # Don't latch: a later *multiprocess* init (e.g. the launcher's env
+        # landing after an early library call) must still go through.
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+        local_device_ids=cfg.local_device_ids,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed up: process %d/%d via %s",
+        cfg.process_id,
+        cfg.num_processes,
+        cfg.coordinator_address,
+    )
+
+
+def initialize_from_env() -> DistributedConfig:
+    """Bootstrap entrypoint every JAXJob worker calls first."""
+    cfg = DistributedConfig.from_env()
+    initialize(cfg)
+    return cfg
+
+
+def _parse_device_ids(raw: str | None) -> tuple[int, ...] | None:
+    if not raw:
+        return None
+    return tuple(int(x) for x in raw.split(",") if x.strip())

@@ -1,0 +1,248 @@
+"""Device mesh construction over ICI/DCN topology.
+
+This is the TPU-native replacement for the reference platform's entire L1
+"communication plane" wiring (SURVEY.md §1 L1, §2.7): where the reference's
+controllers wire NCCL/gloo/MPI via ``MASTER_ADDR``/``TF_CONFIG``/hostfiles and
+the frameworks build process groups, on TPU all collectives are emitted by XLA
+against a single ``jax.sharding.Mesh``. The only "backend" decisions are:
+
+1. which *named logical axes* exist (data / fsdp / model / expert / seq / pipe),
+2. how they map onto the *physical* ICI torus (and a leading DCN axis for
+   multislice), so collectives ride ICI neighbor links rather than hopping.
+
+Reference analog (UNVERIFIED upstream layout, mount empty — SURVEY.md §0):
+[training-operator] pkg/controller.v1/pytorch/envvar.go builds the rendezvous
+env; process-group *factorization* (DPxTPxPP) lives in user containers
+(Megatron/DeepSpeed configs). Here both collapse into ``MeshSpec``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+class Axis:
+    """Canonical logical mesh-axis names.
+
+    Every parallelism strategy in SURVEY.md §2.6 is one named axis:
+
+    - ``DATA``:   pure data parallel (gradient psum; NCCL-allreduce analog).
+    - ``FSDP``:   data parallel with param/grad/opt-state sharding
+                  (ZeRO-3/FSDP analog; XLA inserts all-gather/reduce-scatter).
+    - ``MODEL``:  tensor parallel (Megatron column/row sharding analog).
+    - ``EXPERT``: expert parallel for MoE (all_to_all token dispatch).
+    - ``SEQ``:    sequence/context parallel (Ulysses all_to_all or ring
+                  attention ppermute).
+    - ``PIPE``:   pipeline-stage axis (GPipe/1F1B microbatching).
+    """
+
+    DATA = "data"
+    FSDP = "fsdp"
+    MODEL = "model"
+    EXPERT = "expert"
+    SEQ = "seq"
+    PIPE = "pipe"
+
+    #: Order matters: outermost (slowest-varying, largest communication
+    #: granularity, most DCN-tolerant) first. PIPE and DATA tolerate slow
+    #: links (activations/gradients once per step); MODEL/SEQ need the
+    #: fastest links (per-layer collectives), so they sit innermost where
+    #: `mesh_utils.create_device_mesh` assigns ICI-adjacent devices.
+    ALL = (PIPE, DATA, FSDP, EXPERT, SEQ, MODEL)
+
+    #: Axes over which the *batch* is split — used to compute per-device
+    #: batch sizes and to build data shardings.
+    BATCH = (DATA, FSDP)
+
+
+#: Known single-slice ICI torus shapes for TPU v5e (chips per slice → 2D
+#: physical topology) — SURVEY.md §2.7 "ICI" row. v5e slices are 2D tori.
+V5E_TOPOLOGIES: Mapping[int, tuple[int, ...]] = {
+    1: (1, 1),
+    2: (1, 2),
+    4: (2, 2),
+    8: (2, 4),
+    16: (4, 4),
+    32: (4, 8),
+    64: (8, 8),
+    128: (8, 16),
+    256: (16, 16),
+}
+
+
+def slice_topology(num_devices: int, generation: str = "v5e") -> tuple[int, ...]:
+    """Physical ICI topology for a slice of ``num_devices`` chips.
+
+    Falls back to a near-square 2D factorization for sizes not in the table
+    (e.g. CPU simulation meshes).
+    """
+    del generation  # only v5e shipped in this environment; table is v5e's
+    if num_devices in V5E_TOPOLOGIES:
+        return V5E_TOPOLOGIES[num_devices]
+    # Near-square factorization keeps ring axes short for simulated meshes.
+    a = int(math.sqrt(num_devices))
+    while a > 1 and num_devices % a != 0:
+        a -= 1
+    return (a, num_devices // a)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative logical mesh: named axis sizes plus an optional DCN axis.
+
+    A ``MeshSpec`` is the single source of truth for how a job is
+    parallelized. The orchestrator stores it in the JobSpec; the train loop
+    builds the ``jax.sharding.Mesh`` from it; sharding rules reference its
+    axis names.
+
+    ``dcn_data`` is the leading cross-slice axis for multislice jobs
+    (SURVEY.md §2.7 "DCN" row): data/pipeline parallelism across slices,
+    everything else within a slice.
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+    expert: int = 1
+    seq: int = 1
+    pipe: int = 1
+    dcn_data: int = 1
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def ici_axis_sizes(self) -> dict[str, int]:
+        return {
+            Axis.PIPE: self.pipe,
+            Axis.DATA: self.data,
+            Axis.FSDP: self.fsdp,
+            Axis.EXPERT: self.expert,
+            Axis.SEQ: self.seq,
+            Axis.MODEL: self.model,
+        }
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return Axis.ALL
+
+    @property
+    def axis_sizes(self) -> tuple[int, ...]:
+        """ICI-only logical shape; ``build_mesh`` folds ``dcn_data`` in."""
+        return tuple(self.ici_axis_sizes[name] for name in Axis.ALL)
+
+    @property
+    def ici_devices(self) -> int:
+        return int(np.prod(self.axis_sizes))
+
+    @property
+    def total_devices(self) -> int:
+        return self.ici_devices * self.dcn_data
+
+    @property
+    def batch_partitions(self) -> int:
+        """How many ways the global batch is split (data-like axes x DCN)."""
+        return self.data * self.fsdp * self.dcn_data
+
+    def validate(self, num_devices: int | None = None) -> None:
+        for name, size in self.ici_axis_sizes.items():
+            if size < 1:
+                raise ValueError(f"mesh axis {name!r} must be >=1, got {size}")
+        if self.dcn_data < 1:
+            raise ValueError(f"dcn_data must be >=1, got {self.dcn_data}")
+        if num_devices is not None and self.total_devices != num_devices:
+            raise ValueError(
+                f"MeshSpec wants {self.total_devices} devices "
+                f"({dict(self.ici_axis_sizes)} x dcn_data={self.dcn_data}) "
+                f"but {num_devices} are available"
+            )
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def data_parallel(cls, num_devices: int) -> "MeshSpec":
+        """Pure DP over every device — the DDP/MultiWorkerMirrored analog."""
+        return cls(data=num_devices)
+
+    @classmethod
+    def fsdp_parallel(cls, num_devices: int) -> "MeshSpec":
+        return cls(fsdp=num_devices)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "MeshSpec":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown MeshSpec fields: {sorted(unknown)}")
+        return cls(**{k: int(v) for k, v in d.items()})
+
+    def to_dict(self) -> dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+def build_mesh(
+    spec: MeshSpec,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Materialize a ``jax.sharding.Mesh`` laying logical axes onto hardware.
+
+    Uses ``mesh_utils.create_device_mesh`` so that, on real TPU slices, the
+    innermost logical axes (MODEL, SEQ — the chatty ones) map to physically
+    adjacent chips on the ICI torus, and ``create_hybrid_device_mesh`` when a
+    DCN axis is present so cross-slice traffic is confined to the leading
+    (data) axis. This is the topology-awareness that replaces everything the
+    reference delegated to ``NCCL_*`` env tuning (SURVEY.md §5.8).
+    """
+    if devices is None:
+        devices = jax.devices()
+    spec.validate(len(devices))
+
+    if spec.dcn_data > 1:
+        # Leading DCN axis: replicate the ICI mesh across slices, folding the
+        # DCN factor into the DATA axis position.
+        ici_shape = list(spec.axis_sizes)
+        dcn_shape = [1] * len(ici_shape)
+        data_pos = Axis.ALL.index(Axis.DATA)
+        dcn_shape[data_pos] = spec.dcn_data
+        if hasattr(devices[0], "slice_index"):
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape,
+                dcn_shape,
+                devices=devices,
+                allow_split_physical_axes=True,
+            )
+        else:
+            # CPU-simulation fallback (virtual devices have no slice_index):
+            # contiguous blocks of ici_devices stand in for slices.
+            shape = list(spec.axis_sizes)
+            shape[data_pos] *= spec.dcn_data
+            device_array = np.asarray(devices).reshape(shape)
+        return Mesh(device_array, Axis.ALL)
+
+    device_array = mesh_utils.create_device_mesh(
+        spec.axis_sizes, devices=devices, allow_split_physical_axes=True
+    )
+    return Mesh(device_array, Axis.ALL)
+
+
+def single_device_mesh() -> Mesh:
+    """A trivial mesh on the first local device (serving / smoke tests)."""
+    return build_mesh(MeshSpec(), devices=jax.devices()[:1])
+
+
+def per_device_batch(global_batch: int, spec: MeshSpec) -> int:
+    """Per-batch-shard size; validates divisibility like DDP samplers do."""
+    parts = spec.batch_partitions
+    if global_batch % parts != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"batch partitions {parts} (data={spec.data} x fsdp={spec.fsdp} "
+            f"x dcn={spec.dcn_data})"
+        )
+    return global_batch // parts

@@ -1,0 +1,35 @@
+"""Test harness config: 8 virtual CPU devices, per SURVEY.md §4.
+
+The reference validates its whole multi-node story without real accelerators
+(envtest + gloo-on-kind); our analog is JAX's CPU backend with
+``xla_force_host_platform_device_count=8`` giving a faked 8-device mesh in
+one process. MUST run before the first ``import jax`` anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# This image's sitecustomize imports jax at interpreter startup (with
+# JAX_PLATFORMS=axon already in the env), so jax.config captured 'axon'
+# before this file ran — override through the config API as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
