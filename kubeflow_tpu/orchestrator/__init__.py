@@ -1,0 +1,37 @@
+"""The JAXJob control plane.
+
+Re-imagines the reference's five Go CRD controllers (SURVEY.md §2.1) as one
+Python reconciler engine over an in-process object store:
+
+- ``spec``       — JobSpec / ReplicaSpec / RunPolicy / conditions (the CRD
+                   schema, with training-operator semantics).
+- ``store``      — namespaced object store with watches (the apiserver/etcd
+                   analog; swappable for a real K8s backend later).
+- ``resources``  — simulated TPU fleet: slice pools with ICI topology.
+- ``gang``       — all-or-nothing topology-aware gang scheduler (the
+                   Volcano/coscheduling PodGroup analog).
+- ``envwire``    — per-worker env construction (the setPodEnv/TF_CONFIG
+                   analog, emitting the jax.distributed contract).
+- ``launcher``   — subprocess gang launcher (the kubelet analog).
+- ``reconciler`` — the controller loop: desired vs actual workers, restart
+                   policies, backoff, deadlines, TTL, conditions.
+- ``cluster``    — LocalCluster: store+scheduler+launcher+controller wired
+                   together and run on background threads.
+- ``client``     — TrainingClient: the Python SDK surface.
+"""
+
+from kubeflow_tpu.orchestrator.spec import (  # noqa: F401
+    CleanPodPolicy,
+    JobCondition,
+    JobConditionType,
+    JobSpec,
+    JobStatus,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    SuccessPolicy,
+    TPURequest,
+)
+from kubeflow_tpu.orchestrator.cluster import LocalCluster  # noqa: F401
+from kubeflow_tpu.orchestrator.client import TrainingClient  # noqa: F401

@@ -1,0 +1,130 @@
+"""TrainingClient: the Python SDK surface.
+
+API-compatible in spirit with the reference SDK's ``TrainingClient``
+(SURVEY.md §2.1 "Python SDK" row; upstream analog [training-operator]
+sdk/python/kubeflow/training/api/training_client.py — UNVERIFIED,
+SURVEY.md §0): create/get/wait/logs/delete, plus a high-level ``train()``
+that builds the JAXJob for a python entrypoint.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Mapping, Sequence
+
+from kubeflow_tpu.orchestrator.cluster import LocalCluster
+from kubeflow_tpu.orchestrator.spec import (
+    JobConditionType,
+    JobSpec,
+    JobStatus,
+    ReplicaSpec,
+    RunPolicy,
+    TPURequest,
+)
+
+
+class TrainingClient:
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+        self._by_name: dict[tuple[str, str], str] = {}  # (ns, name) → uid
+
+    # ------------------------------------------------------------------ #
+
+    def create_job(self, spec: JobSpec) -> str:
+        key = (spec.namespace, spec.name)
+        if key in self._by_name and self.cluster.get(self._by_name[key]):
+            raise ValueError(
+                f"job {spec.name!r} already exists in {spec.namespace!r}"
+            )
+        uid = self.cluster.submit(spec)
+        self._by_name[key] = uid
+        return uid
+
+    def train(
+        self,
+        name: str,
+        *,
+        module: str,
+        args: Sequence[str] = (),
+        num_workers: int = 1,
+        chips_per_worker: int = 0,
+        env: Mapping[str, str] | None = None,
+        run_policy: RunPolicy | None = None,
+    ) -> str:
+        """High-level API: launch ``python -m module`` as an SPMD gang —
+        the ``TrainingClient.train()`` fine-tune-analog."""
+        spec = JobSpec(
+            name=name,
+            replicas={
+                "worker": ReplicaSpec(
+                    replicas=num_workers,
+                    command=(sys.executable, "-m", module, *args),
+                    env=dict(env or {}),
+                    tpu=TPURequest(chips=chips_per_worker),
+                )
+            },
+            run_policy=run_policy or RunPolicy(),
+        )
+        return self.create_job(spec)
+
+    # ------------------------------------------------------------------ #
+
+    def _uid(self, name: str, namespace: str = "default") -> str:
+        uid = self._by_name.get((namespace, name))
+        if uid is None:
+            job = self.cluster.find(name, namespace)
+            if job is None:
+                raise KeyError(f"job {name!r} not found in {namespace!r}")
+            uid = job.spec.uid
+        return uid
+
+    def get_job_status(self, name: str, namespace: str = "default") -> JobStatus:
+        status = self.cluster.status(self._uid(name, namespace))
+        if status is None:
+            raise KeyError(f"job {name!r} not found in {namespace!r}")
+        return status
+
+    def wait_for_job_conditions(
+        self,
+        name: str,
+        namespace: str = "default",
+        *,
+        conditions: set[JobConditionType] = frozenset(
+            {JobConditionType.SUCCEEDED}
+        ),
+        timeout: float = 300.0,
+    ) -> JobStatus:
+        uid = self._uid(name, namespace)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            job = self.cluster.get(uid)
+            if job is None:
+                raise KeyError(f"job {name!r} disappeared")
+            for c in job.status.conditions:
+                if c.type in conditions and c.status:
+                    return job.status
+            if job.status.finished:
+                raise RuntimeError(
+                    f"job {name!r} finished as {job.status.phase} "
+                    f"while waiting for {sorted(c.value for c in conditions)}: "
+                    f"{job.status.condition().message}"
+                )
+            time.sleep(0.05)
+        raise TimeoutError(f"job {name!r}: conditions not met in {timeout}s")
+
+    def get_job_logs(
+        self, name: str, namespace: str = "default",
+        replica_type: str = "worker", index: int = 0,
+    ) -> str:
+        return self.cluster.logs(self._uid(name, namespace), replica_type, index)
+
+    def delete_job(self, name: str, namespace: str = "default") -> None:
+        self.cluster.delete(self._uid(name, namespace))
+
+    def list_jobs(self, namespace: str = "default") -> list[JobSpec]:
+        return [
+            j.spec
+            for _, j in self.cluster.jobs.list()
+            if j.spec.namespace == namespace
+        ]

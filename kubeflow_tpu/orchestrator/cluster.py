@@ -1,0 +1,169 @@
+"""LocalCluster: the whole control plane wired together on one host.
+
+store + gang scheduler + launcher + controller loop — the single-binary
+analog of apiserver + scheduler + kubelet + training-operator for this
+clusterless dev environment (SURVEY.md §7 env constraints). The controller
+loop is event-driven (store watches) with a periodic resync for time-based
+policies (deadlines, TTL, restart backoff), like controller-runtime's
+informer resync.
+"""
+
+from __future__ import annotations
+
+import logging
+import tempfile
+import threading
+import time
+
+from kubeflow_tpu.orchestrator.envwire import WiringConfig
+from kubeflow_tpu.orchestrator.gang import GangScheduler
+from kubeflow_tpu.orchestrator.launcher import ProcessLauncher
+from kubeflow_tpu.orchestrator.reconciler import JobController, JobObject
+from kubeflow_tpu.orchestrator.resources import Fleet
+from kubeflow_tpu.orchestrator.spec import JobSpec, JobStatus
+from kubeflow_tpu.orchestrator.store import ObjectStore
+
+logger = logging.getLogger(__name__)
+
+
+class LocalCluster:
+    def __init__(
+        self,
+        fleet: Fleet | None = None,
+        wiring: WiringConfig | None = None,
+        *,
+        base_dir: str | None = None,
+        resync_period: float = 0.1,
+        restart_backoff_base: float = 1.0,
+    ):
+        self.fleet = fleet or Fleet.single_host(chips=8)
+        self.wiring = wiring or WiringConfig(platform="cpu_sim")
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="kft-cluster-")
+        self.jobs = ObjectStore("jobs")
+        self.workers = ObjectStore("workers")
+        self.scheduler = GangScheduler(self.fleet)
+        self.launcher = ProcessLauncher(self.workers, self.base_dir)
+        self.controller = JobController(
+            self.jobs,
+            self.workers,
+            self.scheduler,
+            self.launcher,
+            self.wiring,
+            restart_backoff_base=restart_backoff_base,
+        )
+        self._resync = resync_period
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._watches = []
+
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> "LocalCluster":
+        if self._thread is not None:
+            return self
+        for store in (self.jobs, self.workers):
+            watch = store.watch()
+            self._watches.append(watch)
+            threading.Thread(
+                target=self._pump, args=(watch,), daemon=True
+            ).start()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _pump(self, watch) -> None:
+        for _ in watch:
+            self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self._resync)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            self.controller.sync_all()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        for w in self._watches:
+            w.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.launcher.shutdown()
+
+    def __enter__(self) -> "LocalCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- job API (what the SDK client calls) --------------------------- #
+
+    def submit(self, spec: JobSpec) -> str:
+        self.jobs.create(spec.uid, JobObject(spec=spec))
+        self._wake.set()
+        return spec.uid
+
+    def get(self, uid: str) -> JobObject | None:
+        return self.jobs.get(uid)
+
+    def find(self, name: str, namespace: str = "default") -> JobObject | None:
+        for _, job in self.jobs.list():
+            if job.spec.name == name and job.spec.namespace == namespace:
+                return job
+        return None
+
+    def status(self, uid: str) -> JobStatus | None:
+        job = self.jobs.get(uid)
+        return job.status if job else None
+
+    def delete(self, uid: str) -> None:
+        job: JobObject | None = self.jobs.get(uid)
+        if job is None:
+            return
+        job.deletion_requested = True
+        self.jobs.update(uid, job)
+        self._wake.set()
+
+    def wait(
+        self,
+        uid: str,
+        timeout: float = 300.0,
+        *,
+        poll: float = 0.05,
+    ) -> JobStatus:
+        """Block until the job reaches a terminal condition (or is deleted)."""
+        deadline = time.time() + timeout
+        last: JobStatus | None = None
+        while time.time() < deadline:
+            job = self.jobs.get(uid)
+            if job is None:
+                if last is not None:
+                    return last  # TTL'd away after finishing
+                raise KeyError(f"job {uid} not found")
+            last = job.status
+            if job.status.finished:
+                return job.status
+            time.sleep(poll)
+        raise TimeoutError(
+            f"job {uid} not finished after {timeout}s "
+            f"(phase {last.phase if last else 'Unknown'})"
+        )
+
+    def logs(self, uid: str, rtype: str, index: int, attempt: int | None = None) -> str:
+        """Concatenated (or single-attempt) worker logs."""
+        w = self.workers.get(f"{uid}/{rtype}-{index}")
+        attempts = (
+            [attempt]
+            if attempt is not None
+            else range((w.restarts if w else 0) + 1)
+        )
+        chunks = []
+        for a in attempts:
+            p = self.launcher.log_path(uid, rtype, index, a)
+            if p.exists():
+                chunks.append(p.read_text(errors="replace"))
+        return "".join(chunks)

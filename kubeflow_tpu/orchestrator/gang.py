@@ -1,0 +1,110 @@
+"""Gang scheduler: all-or-nothing, topology-aware, queued.
+
+The Volcano/coscheduling PodGroup analog the reference creates when
+``RunPolicy.schedulingPolicy`` is set (SURVEY.md §2.1 "Gang scheduling" row):
+a job's workers are admitted only when the whole gang fits the fleet, so 16
+concurrent tuning trials (SURVEY.md §3.4) can't deadlock holding partial
+slice claims.
+
+Policy: per-queue strict priority, then FIFO; no backfill past a blocked
+higher-priority gang within the same queue (prevents starvation of large
+gangs — the failure mode strict gang scheduling exists to avoid). Separate
+queues (``SchedulingPolicy.queue``) are independent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from kubeflow_tpu.orchestrator.resources import Claim, Fleet
+
+
+@dataclasses.dataclass
+class PodGroup:
+    """One gang awaiting (or holding) placement."""
+
+    job_uid: str
+    # per member, in worker order: (worker_key, chips, topology|None, generation)
+    requests: list[tuple[str, int, str | None, str]]
+    queue: str = "default"
+    priority: int = 0
+    timeout_seconds: float | None = None
+    enqueued_at: float = dataclasses.field(default_factory=time.time)
+    claims: dict[str, Claim] | None = None  # worker_key → claim once admitted
+
+    @property
+    def admitted(self) -> bool:
+        return self.claims is not None
+
+    @property
+    def expired(self) -> bool:
+        return (
+            self.timeout_seconds is not None
+            and not self.admitted
+            and time.time() - self.enqueued_at > self.timeout_seconds
+        )
+
+
+class GangScheduler:
+    def __init__(self, fleet: Fleet):
+        self.fleet = fleet
+        self._lock = threading.Lock()
+        self._pending: dict[str, PodGroup] = {}  # job_uid → group
+        self._held: dict[str, PodGroup] = {}     # admitted, claims held
+
+    def enqueue(self, group: PodGroup) -> None:
+        with self._lock:
+            if group.job_uid in self._pending or group.job_uid in self._held:
+                return
+            self._pending[group.job_uid] = group
+
+    def cancel(self, job_uid: str) -> None:
+        """Drop from queue and release claims if held."""
+        with self._lock:
+            self._pending.pop(job_uid, None)
+            group = self._held.pop(job_uid, None)
+        if group and group.claims:
+            self.fleet.release(list(group.claims.values()))
+
+    def claims_for(self, job_uid: str) -> dict[str, Claim] | None:
+        with self._lock:
+            g = self._held.get(job_uid)
+            return dict(g.claims) if g and g.claims else None
+
+    def timed_out(self) -> list[PodGroup]:
+        with self._lock:
+            out = [g for g in self._pending.values() if g.expired]
+            for g in out:
+                del self._pending[g.job_uid]
+            return out
+
+    def try_schedule(self) -> list[PodGroup]:
+        """Admit every gang that fits, honoring per-queue priority+FIFO
+        without skipping a blocked head-of-line gang. Returns newly admitted
+        groups (claims filled in)."""
+        admitted: list[PodGroup] = []
+        with self._lock:
+            by_queue: dict[str, list[PodGroup]] = {}
+            for g in self._pending.values():
+                by_queue.setdefault(g.queue, []).append(g)
+            for q, groups in by_queue.items():
+                groups.sort(key=lambda g: (-g.priority, g.enqueued_at))
+                for g in groups:
+                    claims = self.fleet.claim_gang(
+                        [(chips, topo, gen) for _, chips, topo, gen in g.requests]
+                    )
+                    if claims is None:
+                        break  # head-of-line blocks the rest of this queue
+                    g.claims = {
+                        g.requests[i][0]: claims[i] for i in range(len(claims))
+                    }
+                    del self._pending[g.job_uid]
+                    self._held[g.job_uid] = g
+                    admitted.append(g)
+        return admitted
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)

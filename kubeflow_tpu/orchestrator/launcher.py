@@ -1,0 +1,133 @@
+"""Process gang launcher — the kubelet analog.
+
+Runs gang members as local subprocesses with per-attempt log files, watches
+exits on monitor threads, and reports phase transitions into the worker
+store. The reconciler never talks to processes directly; it sees only
+``WorkerStatus`` records — the same pod-status contract the reference
+controllers consume (SURVEY.md §3.1 "node/kubelet boundary").
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import threading
+from pathlib import Path
+
+from kubeflow_tpu.orchestrator.spec import WorkerPhase, WorkerStatus
+from kubeflow_tpu.orchestrator.store import ObjectStore
+
+logger = logging.getLogger(__name__)
+
+
+class ProcessLauncher:
+    def __init__(self, worker_store: ObjectStore, base_dir: str | os.PathLike):
+        self.workers = worker_store
+        self.base_dir = Path(base_dir)
+        self._lock = threading.Lock()
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    # ------------------------------------------------------------------ #
+
+    def log_path(self, job_uid: str, rtype: str, index: int, attempt: int) -> Path:
+        d = self.base_dir / f"job-{job_uid}" / f"{rtype}-{index}"
+        d.mkdir(parents=True, exist_ok=True)
+        return d / f"attempt-{attempt}.log"
+
+    def workdir(self, job_uid: str) -> Path:
+        d = self.base_dir / f"job-{job_uid}" / "work"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def start(
+        self,
+        worker: WorkerStatus,
+        command: tuple[str, ...],
+        env: dict[str, str],
+    ) -> None:
+        """Spawn one member; updates the store to RUNNING with the pid."""
+        key = worker.key
+        attempt = worker.restarts
+        log_file = self.log_path(
+            worker.job_uid, worker.replica_type, worker.index, attempt
+        )
+        with self._lock:
+            with open(log_file, "ab") as f:
+                proc = subprocess.Popen(
+                    list(command),
+                    env=env,
+                    stdout=f,
+                    stderr=subprocess.STDOUT,
+                    cwd=str(self.workdir(worker.job_uid)),
+                    start_new_session=True,  # isolate signals per worker
+                )
+            self._procs[key] = proc
+
+        def _set_running(w: WorkerStatus) -> None:
+            w.phase = WorkerPhase.RUNNING
+            w.pid = proc.pid
+            w.exit_code = None
+            w.message = f"attempt {attempt}"
+
+        self.workers.mutate(key, _set_running)
+        threading.Thread(
+            target=self._monitor, args=(key, proc), daemon=True
+        ).start()
+        logger.info("started %s pid=%d attempt=%d", key, proc.pid, attempt)
+
+    def _monitor(self, key: str, proc: subprocess.Popen) -> None:
+        code = proc.wait()
+        if code < 0:
+            # Popen reports signal death as -N; normalize to the container
+            # convention 128+N that RestartPolicy.EXIT_CODE keys off
+            # (SIGKILL → 137), matching the reference's semantics.
+            code = 128 - code
+
+        def _finish(w: WorkerStatus) -> None:
+            if w.pid != proc.pid:
+                return  # superseded by a restart; stale monitor
+            w.exit_code = code
+            w.phase = (
+                WorkerPhase.SUCCEEDED if code == 0 else WorkerPhase.FAILED
+            )
+            w.message = f"exit code {code}"
+
+        try:
+            self.workers.mutate(key, _finish)
+        except KeyError:
+            pass  # worker record deleted (job TTL'd) while process ran
+        with self._lock:
+            if self._procs.get(key) is proc:
+                del self._procs[key]
+
+    # ------------------------------------------------------------------ #
+
+    def kill(self, key: str, sig: int = signal.SIGKILL) -> bool:
+        """Kill a member's process group. The monitor thread records the
+        resulting phase (Failed, exit 128+sig) — matching pod-kill
+        observability in the reference."""
+        with self._lock:
+            proc = self._procs.get(key)
+        if proc is None or proc.poll() is not None:
+            return False
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+        return True
+
+    def alive(self, key: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(key)
+        return proc is not None and proc.poll() is None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            keys = list(self._procs)
+        for k in keys:
+            self.kill(k)

@@ -1,0 +1,378 @@
+"""The JAXJob reconciler: desired vs actual gang state.
+
+One engine serving every job kind, like the reference's shared common
+reconciler that all five operators delegate to (SURVEY.md §2.1 "Common job
+reconciler"; upstream analog [training-operator]
+pkg/controller.v1/common/{job,pod,status}.go — UNVERIFIED, SURVEY.md §0).
+
+Condition flow: Created → Queued → Running → (Restarting → Running)* →
+Succeeded | Failed, with RunPolicy enforcement (backoff limit with
+exponential delay, active deadline, TTL-after-finished, cleanPodPolicy) and
+per-replica RestartPolicy incl. ExitCode semantics.
+
+TPU-native divergence (deliberate): worker failure restarts the WHOLE gang,
+not just the failed pod. JAX SPMD worlds are static — the coordinator aborts
+every peer when one dies (SURVEY.md §5.3) — so single-pod restart as in the
+reference would thrash. Restart-the-gang + checkpoint-restore is the
+elasticity model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+from kubeflow_tpu.orchestrator import envwire
+from kubeflow_tpu.orchestrator.gang import GangScheduler, PodGroup
+from kubeflow_tpu.orchestrator.launcher import ProcessLauncher
+from kubeflow_tpu.orchestrator.spec import (
+    CleanPodPolicy,
+    JobConditionType as CT,
+    JobSpec,
+    JobStatus,
+    SuccessPolicy,
+    WorkerPhase,
+    WorkerStatus,
+    worker_key,
+)
+from kubeflow_tpu.orchestrator.store import ObjectStore
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class JobObject:
+    """What the job store holds: spec + status + controller bookkeeping."""
+
+    spec: JobSpec
+    status: JobStatus = dataclasses.field(default_factory=JobStatus)
+    coordinator_port: int = 0
+    next_restart_at: float = 0.0
+    deletion_requested: bool = False
+
+
+class JobController:
+    """Synchronous reconcile logic; the cluster loop calls ``sync_all``."""
+
+    def __init__(
+        self,
+        jobs: ObjectStore,
+        workers: ObjectStore,
+        scheduler: GangScheduler,
+        launcher: ProcessLauncher,
+        wiring: envwire.WiringConfig,
+        *,
+        restart_backoff_base: float = 1.0,
+        kill_wait_seconds: float = 5.0,
+    ):
+        self.jobs = jobs
+        self.workers = workers
+        self.scheduler = scheduler
+        self.launcher = launcher
+        self.wiring = wiring
+        self.restart_backoff_base = restart_backoff_base
+        self.kill_wait_seconds = kill_wait_seconds
+
+    # ------------------------------------------------------------------ #
+
+    def sync_all(self) -> None:
+        self.scheduler.try_schedule()
+        for uid, _ in self.jobs.list():
+            try:
+                self.sync_job(uid)
+            except Exception:  # noqa: BLE001 — a bad job must not wedge the loop
+                logger.exception("reconcile failed for job %s", uid)
+
+    def sync_job(self, uid: str) -> None:
+        job: JobObject | None = self.jobs.get(uid)
+        if job is None:
+            return
+        spec, status = job.spec, job.status
+
+        if job.deletion_requested:
+            self._cleanup(job, kill_all=True)
+            self._delete_records(uid)
+            return
+
+        if status.finished:
+            self._maybe_ttl(job)
+            return
+
+        if status.push(CT.CREATED, reason="JobCreated"):
+            self.jobs.update(uid, job)
+
+        # -- active deadline ------------------------------------------- #
+        deadline = spec.run_policy.active_deadline_seconds
+        if (
+            deadline is not None
+            and status.start_time is not None
+            and time.time() - status.start_time > deadline
+        ):
+            self._finish(job, CT.FAILED, "DeadlineExceeded",
+                         f"active deadline {deadline}s exceeded")
+            return
+
+        # -- desired worker set ---------------------------------------- #
+        desired = self._ensure_workers(spec)
+
+        # -- gang admission -------------------------------------------- #
+        claims = self.scheduler.claims_for(uid)
+        if claims is None:
+            self._enqueue_gang(job, desired)
+            self.scheduler.try_schedule()
+            for g in self.scheduler.timed_out():
+                j: JobObject | None = self.jobs.get(g.job_uid)
+                if j is not None and not j.status.finished:
+                    self._finish(
+                        j, CT.FAILED, "Unschedulable",
+                        "gang scheduling timeout: fleet cannot place the gang",
+                    )
+            job = self.jobs.get(uid)
+            if job is None or job.status.finished:
+                return
+            claims = self.scheduler.claims_for(uid)
+            if claims is None:
+                if job.status.push(CT.QUEUED, reason="GangPending"):
+                    self.jobs.update(uid, job)
+                return
+        status = job.status
+
+        # -- placement + launch ---------------------------------------- #
+        for w in desired:
+            if w.phase is WorkerPhase.PENDING:
+                claim = claims.get(w.key)
+                self.workers.mutate(
+                    w.key,
+                    lambda ws, c=claim: _assign(ws, c),
+                )
+        if job.coordinator_port == 0:
+            job.coordinator_port = envwire.free_port()
+            self.jobs.update(uid, job)
+
+        if time.time() >= job.next_restart_at:
+            for _, w in self.workers.list(prefix=f"{uid}/"):
+                if w.phase is WorkerPhase.SCHEDULED:
+                    self._launch(job, w)
+
+        # -- aggregate ------------------------------------------------- #
+        ws = [w for _, w in self.workers.list(prefix=f"{uid}/")]
+        dirty = self._update_replica_statuses(job, ws)
+        running = [w for w in ws if w.phase is WorkerPhase.RUNNING]
+        failed = [w for w in ws if w.phase is WorkerPhase.FAILED]
+        succeeded = [w for w in ws if w.phase is WorkerPhase.SUCCEEDED]
+
+        if running and status.start_time is None:
+            status.start_time = time.time()
+            dirty = True
+        if len(running) == len(ws):
+            dirty |= status.push(CT.RUNNING, reason="AllWorkersRunning")
+
+        # -- success --------------------------------------------------- #
+        policy = spec.run_policy.success_policy
+        if policy is SuccessPolicy.ALL_WORKERS and len(succeeded) == len(ws):
+            self._finish(job, CT.SUCCEEDED, "AllWorkersSucceeded",
+                         "every gang member exited 0")
+            return
+        if policy is SuccessPolicy.RANK0:
+            rank0 = self._rank0_worker(spec, ws)
+            if rank0 is not None and rank0.phase is WorkerPhase.SUCCEEDED:
+                self._finish(job, CT.SUCCEEDED, "Rank0Succeeded",
+                             "coordinator replica exited 0")
+                return
+
+        # -- failure / gang restart ------------------------------------ #
+        if failed:
+            self._handle_failures(job, ws, failed)
+            return
+
+        # Emit a watch event only on a real transition — an unconditional
+        # update would wake our own loop and busy-spin the controller.
+        if dirty:
+            self.jobs.update(uid, job)
+
+    # ------------------------------------------------------------------ #
+
+    def _ensure_workers(self, spec: JobSpec) -> list[WorkerStatus]:
+        out = []
+        for rtype, rspec in spec.replicas.items():
+            for i in range(rspec.replicas):
+                key = worker_key(spec.uid, rtype, i)
+                w = self.workers.get(key)
+                if w is None:
+                    w = WorkerStatus(
+                        job_uid=spec.uid, replica_type=rtype, index=i
+                    )
+                    self.workers.create(key, w)
+                out.append(w)
+        return out
+
+    def _enqueue_gang(self, job: JobObject, desired: list[WorkerStatus]) -> None:
+        spec = job.spec
+        sched = spec.run_policy.scheduling
+        requests = []
+        for w in desired:
+            tpu = spec.replicas[w.replica_type].tpu
+            requests.append((w.key, tpu.chips, tpu.topology, tpu.generation))
+        self.scheduler.enqueue(
+            PodGroup(
+                job_uid=spec.uid,
+                requests=requests,
+                queue=sched.queue,
+                priority=sched.priority,
+                timeout_seconds=sched.timeout_seconds,
+            )
+        )
+
+    def _launch(self, job: JobObject, w: WorkerStatus) -> None:
+        spec = job.spec
+        rspec = spec.replicas[w.replica_type]
+        env = envwire.build_worker_env(
+            spec,
+            w.replica_type,
+            w.index,
+            coordinator_port=job.coordinator_port,
+            wiring=self.wiring,
+            workdir=str(self.launcher.workdir(spec.uid)),
+            attempt=w.restarts,
+        )
+        self.launcher.start(w, rspec.command, env)
+
+    def _handle_failures(
+        self, job: JobObject, ws: list[WorkerStatus], failed: list[WorkerStatus]
+    ) -> None:
+        spec, status = job.spec, job.status
+        nonretryable = [
+            w
+            for w in failed
+            if not spec.replicas[w.replica_type].restart_policy.should_restart(
+                w.exit_code if w.exit_code is not None else 1
+            )
+        ]
+        if nonretryable:
+            w = nonretryable[0]
+            self._finish(
+                job, CT.FAILED, "NonRetryableExit",
+                f"{w.key} exited {w.exit_code} "
+                f"(policy {spec.replicas[w.replica_type].restart_policy.value})",
+            )
+            return
+        if status.restart_count >= spec.run_policy.backoff_limit:
+            self._finish(
+                job, CT.FAILED, "BackoffLimitExceeded",
+                f"restarted {status.restart_count}x "
+                f"(limit {spec.run_policy.backoff_limit})",
+            )
+            return
+
+        # Gang restart: kill survivors, re-schedule everyone.
+        status.restart_count += 1
+        status.push(
+            CT.RESTARTING, reason="GangRestart",
+            message=f"{failed[0].key} exited {failed[0].exit_code}; "
+                    f"restart {status.restart_count}/{spec.run_policy.backoff_limit}",
+        )
+        job.next_restart_at = time.time() + self.restart_backoff_base * (
+            2 ** (status.restart_count - 1)
+        )
+        # New coordinator port per attempt: the old rank-0 process may still
+        # hold the previous one while dying.
+        job.coordinator_port = envwire.free_port()
+        self.jobs.update(job.spec.uid, job)
+
+        for w in ws:
+            if w.phase is WorkerPhase.RUNNING:
+                self.launcher.kill(w.key)
+        self._wait_dead(ws)
+        for w in ws:
+            self.workers.mutate(w.key, _reset_for_restart)
+
+    def _rank0_worker(
+        self, spec: JobSpec, ws: list[WorkerStatus]
+    ) -> WorkerStatus | None:
+        ranks = spec.global_ranks()
+        for w in ws:
+            if ranks.get((w.replica_type, w.index)) == 0:
+                return w
+        return None
+
+    def _update_replica_statuses(
+        self, job: JobObject, ws: list[WorkerStatus]
+    ) -> bool:
+        """Recompute aggregate counts; True if they changed."""
+        agg: dict[str, dict[str, int]] = {}
+        for w in ws:
+            a = agg.setdefault(
+                w.replica_type, {"active": 0, "succeeded": 0, "failed": 0}
+            )
+            if w.phase is WorkerPhase.RUNNING:
+                a["active"] += 1
+            elif w.phase is WorkerPhase.SUCCEEDED:
+                a["succeeded"] += 1
+            elif w.phase is WorkerPhase.FAILED:
+                a["failed"] += 1
+        changed = agg != job.status.replica_statuses
+        job.status.replica_statuses = agg
+        return changed
+
+    # ------------------------------------------------------------------ #
+
+    def _finish(
+        self, job: JobObject, ctype: CT, reason: str, message: str
+    ) -> None:
+        job.status.push(ctype, reason=reason, message=message)
+        job.status.completion_time = time.time()
+        self._cleanup(
+            job,
+            kill_all=job.spec.run_policy.clean_pod_policy
+            is not CleanPodPolicy.NONE,
+        )
+        self.jobs.update(job.spec.uid, job)
+        logger.info(
+            "job %s finished: %s (%s) %s",
+            job.spec.name, ctype.value, reason, message,
+        )
+
+    def _cleanup(self, job: JobObject, *, kill_all: bool) -> None:
+        uid = job.spec.uid
+        if kill_all:
+            for key, w in self.workers.list(prefix=f"{uid}/"):
+                if w.phase is WorkerPhase.RUNNING:
+                    self.launcher.kill(key)
+        self.scheduler.cancel(uid)
+
+    def _maybe_ttl(self, job: JobObject) -> None:
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is None or job.status.completion_time is None:
+            return
+        if time.time() - job.status.completion_time >= ttl:
+            self._delete_records(job.spec.uid)
+
+    def _delete_records(self, uid: str) -> None:
+        for key, w in self.workers.list(prefix=f"{uid}/"):
+            if w.phase is WorkerPhase.RUNNING:
+                self.launcher.kill(key)
+            self.workers.delete(key)
+        self.scheduler.cancel(uid)
+        self.jobs.delete(uid)
+
+    def _wait_dead(self, ws: list[WorkerStatus]) -> None:
+        deadline = time.time() + self.kill_wait_seconds
+        while time.time() < deadline:
+            if not any(self.launcher.alive(w.key) for w in ws):
+                return
+            time.sleep(0.02)
+        logger.warning("some workers still alive after kill wait")
+
+
+def _assign(w: WorkerStatus, claim) -> None:
+    w.phase = WorkerPhase.SCHEDULED
+    w.slice_id = claim.slice_id if claim else None
+
+
+def _reset_for_restart(w: WorkerStatus) -> None:
+    w.phase = WorkerPhase.SCHEDULED
+    w.restarts += 1
+    w.exit_code = None
+    w.pid = None
+    w.message = "awaiting gang restart"

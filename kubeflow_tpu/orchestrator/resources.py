@@ -1,0 +1,175 @@
+"""Simulated TPU fleet: slice pools with ICI topology.
+
+The reference delegates capacity to the K8s scheduler over ``nvidia.com/gpu``
+counts; TPU capacity is *topological* — you claim whole slices (or sub-slice
+chip groups) whose shape determines the ICI mesh. This model is what the gang
+scheduler places against (SURVEY.md §7 "hard part 1": a rigorous simulated
+capacity model, since no real cluster exists in this env).
+
+A fleet is a set of ``SlicePool``s (e.g. 4 slices of v5e-16 "4x4"). A claim
+asks for ``chips`` within one slice (sub-slice claim, like GKE multi-host
+sub-scheduling) or a whole slice by topology string.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+
+from kubeflow_tpu.core.mesh import slice_topology
+
+
+def parse_topology(s: str) -> tuple[int, ...]:
+    """'4x4' → (4, 4)."""
+    try:
+        dims = tuple(int(p) for p in s.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"bad topology string {s!r}") from e
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(f"bad topology string {s!r}")
+    return dims
+
+
+def topology_chips(s: str) -> int:
+    return math.prod(parse_topology(s))
+
+
+@dataclasses.dataclass
+class Slice:
+    """One TPU pod slice: an atomic ICI domain."""
+
+    slice_id: str
+    topology: str
+    generation: str = "v5e"
+    free_chips: int = dataclasses.field(default=-1)
+
+    def __post_init__(self) -> None:
+        if self.free_chips < 0:
+            self.free_chips = self.total_chips
+
+    @property
+    def total_chips(self) -> int:
+        return topology_chips(self.topology)
+
+
+@dataclasses.dataclass(frozen=True)
+class Claim:
+    """A granted placement: chips on one slice."""
+
+    slice_id: str
+    chips: int
+
+
+class Fleet:
+    """Thread-safe capacity ledger over a set of slices.
+
+    ``claim_gang`` is all-or-nothing: every member's chips must fit
+    simultaneously (each member within a single slice — chips never span
+    slices, because a jax process's local devices are one ICI domain), else
+    nothing is allocated. This is the PodGroup minMember semantic.
+    """
+
+    def __init__(self, slices: list[Slice] | None = None):
+        self._lock = threading.Lock()
+        self._slices: dict[str, Slice] = {}
+        for s in slices or []:
+            self.add_slice(s)
+
+    @classmethod
+    def homogeneous(
+        cls, num_slices: int, topology: str, generation: str = "v5e"
+    ) -> "Fleet":
+        return cls(
+            [
+                Slice(f"slice-{i}", topology, generation)
+                for i in range(num_slices)
+            ]
+        )
+
+    @classmethod
+    def single_host(cls, chips: int = 1, generation: str = "v5e") -> "Fleet":
+        topo = "x".join(str(d) for d in slice_topology(chips))
+        return cls([Slice("slice-0", topo, generation)])
+
+    def add_slice(self, s: Slice) -> None:
+        with self._lock:
+            if s.slice_id in self._slices:
+                raise KeyError(f"slice {s.slice_id} already registered")
+            self._slices[s.slice_id] = s
+
+    def remove_slice(self, slice_id: str) -> None:
+        """Simulate slice loss (preemption/maintenance) — claims vanish."""
+        with self._lock:
+            self._slices.pop(slice_id, None)
+
+    # ------------------------------------------------------------------ #
+
+    def snapshot(self) -> dict[str, Slice]:
+        with self._lock:
+            return {k: dataclasses.replace(v) for k, v in self._slices.items()}
+
+    def total_chips(self) -> int:
+        with self._lock:
+            return sum(s.total_chips for s in self._slices.values())
+
+    def free_chips(self) -> int:
+        with self._lock:
+            return sum(s.free_chips for s in self._slices.values())
+
+    def claim_gang(
+        self,
+        requests: list[tuple[int, str | None, str]],
+    ) -> list[Claim] | None:
+        """Try to place a gang atomically.
+
+        ``requests``: per member ``(chips, topology_or_None, generation)``.
+        A topology request means "a whole slice of exactly this shape".
+        Placement is best-fit (fullest feasible slice first) to reduce
+        fragmentation across concurrent gangs (the Katib 16-trial pressure
+        case, SURVEY.md §3.4). Returns claims in request order, or None.
+        """
+        with self._lock:
+            free = {k: s.free_chips for k, s in self._slices.items()}
+            claims: list[Claim] = []
+            # Place whole-slice (topology) requests first: they are the most
+            # constrained.
+            order = sorted(
+                range(len(requests)),
+                key=lambda i: (requests[i][1] is None, -requests[i][0]),
+            )
+            placed: dict[int, Claim] = {}
+            for i in order:
+                chips, topo, gen = requests[i]
+                candidates = []
+                for sid, s in self._slices.items():
+                    if s.generation != gen:
+                        continue
+                    if topo is not None:
+                        if s.topology != topo or free[sid] != s.total_chips:
+                            continue
+                        need = s.total_chips
+                    else:
+                        need = chips
+                        if free[sid] < need:
+                            continue
+                    candidates.append((free[sid], sid, need))
+                if not candidates:
+                    return None
+                # Best-fit: least free capacity that still fits.
+                candidates.sort()
+                _, sid, need = candidates[0]
+                free[sid] -= need
+                placed[i] = Claim(sid, need)
+            for i in range(len(requests)):
+                claims.append(placed[i])
+            for c in claims:
+                self._slices[c.slice_id].free_chips -= c.chips
+            return claims
+
+    def release(self, claims: list[Claim]) -> None:
+        with self._lock:
+            for c in claims:
+                s = self._slices.get(c.slice_id)
+                if s is not None:
+                    s.free_chips = min(s.free_chips + c.chips, s.total_chips)

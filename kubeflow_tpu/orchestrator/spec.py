@@ -1,0 +1,406 @@
+"""JAXJob API types: the CRD schema with training-operator semantics.
+
+Mirrors the semantics of the reference's common API types so that reference
+job manifests translate 1:1 (SURVEY.md §2.1 "API types" row; upstream analog
+[training-operator] pkg/apis/kubeflow.org/v1/common_types.go — UNVERIFIED,
+mount empty, SURVEY.md §0):
+
+- ``ReplicaSpec``     ← replicas / template / restartPolicy (incl. ExitCode)
+- ``RunPolicy``       ← backoffLimit, activeDeadlineSeconds, cleanPodPolicy,
+                        ttlSecondsAfterFinished, schedulingPolicy
+- ``JobCondition``    ← Created / Running / Restarting / Succeeded / Failed
+- ``SchedulingPolicy``← gang minAvailable / queue / priority
+
+TPU-first additions: ``TPURequest`` (accelerator topology replaces
+``nvidia.com/gpu`` counts) and ``MeshSpec`` embedding (the job carries its
+logical parallelism layout, SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+import uuid
+from typing import Any, Mapping
+
+from kubeflow_tpu.core.mesh import MeshSpec
+
+
+class RestartPolicy(str, enum.Enum):
+    """Per-replica restart semantics (training-operator compatible).
+
+    ``EXIT_CODE``: retry only on *retryable* exit codes — 128+ (signal
+    deaths: SIGKILL=137, SIGSEGV=139, preemption) — and permanently fail on
+    1..127 (application errors). This is the subtle state machine SURVEY.md
+    §7 "hard part 5" warns about.
+    """
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"
+
+    def should_restart(self, exit_code: int) -> bool:
+        if self is RestartPolicy.ALWAYS:
+            return True
+        if self is RestartPolicy.ON_FAILURE:
+            return exit_code != 0
+        if self is RestartPolicy.EXIT_CODE:
+            return exit_code >= 128
+        return False
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """Which workers to kill when the job finishes."""
+
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class SuccessPolicy(str, enum.Enum):
+    """When the job counts as Succeeded.
+
+    ``ALL_WORKERS`` is the right default for SPMD gangs (every jax process
+    exits together); ``RANK0`` mirrors PyTorchJob's master-exit semantics.
+    """
+
+    ALL_WORKERS = "AllWorkers"
+    RANK0 = "Rank0"
+
+
+class JobConditionType(str, enum.Enum):
+    CREATED = "Created"
+    QUEUED = "Queued"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class JobCondition:
+    type: JobConditionType
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition: float = dataclasses.field(default_factory=time.time)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["type"] = self.type.value
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class TPURequest:
+    """Accelerator claim: the ``google.com/tpu`` + topology-selector analog.
+
+    ``topology`` is an ICI shape string ("2x4"); ``chips`` per worker. The
+    gang scheduler matches these against slice pools (SURVEY.md §3.1 "TPU
+    mapping": ``google.com/tpu: 4`` + ``gke-tpu-topology`` selector).
+    """
+
+    chips: int = 0
+    topology: str | None = None
+    generation: str = "v5e"
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "TPURequest":
+        return cls(
+            chips=int(d.get("chips", 0)),
+            topology=d.get("topology"),
+            generation=d.get("generation", "v5e"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulingPolicy:
+    """Gang scheduling knobs (the Volcano PodGroup analog)."""
+
+    gang: bool = True
+    min_available: int | None = None  # default: all replicas
+    queue: str = "default"
+    priority: int = 0
+    timeout_seconds: float | None = None  # fail if unschedulable this long
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "SchedulingPolicy":
+        return cls(
+            gang=bool(d.get("gang", True)),
+            min_available=d.get("min_available"),
+            queue=d.get("queue", "default"),
+            priority=int(d.get("priority", 0)),
+            timeout_seconds=d.get("timeout_seconds"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class RunPolicy:
+    backoff_limit: int = 3
+    active_deadline_seconds: float | None = None
+    ttl_seconds_after_finished: float | None = None
+    clean_pod_policy: CleanPodPolicy = CleanPodPolicy.RUNNING
+    scheduling: SchedulingPolicy = dataclasses.field(default_factory=SchedulingPolicy)
+    success_policy: SuccessPolicy = SuccessPolicy.ALL_WORKERS
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "RunPolicy":
+        return cls(
+            backoff_limit=int(d.get("backoff_limit", 3)),
+            active_deadline_seconds=d.get("active_deadline_seconds"),
+            ttl_seconds_after_finished=d.get("ttl_seconds_after_finished"),
+            clean_pod_policy=CleanPodPolicy(d.get("clean_pod_policy", "Running")),
+            scheduling=SchedulingPolicy.from_dict(d.get("scheduling", {})),
+            success_policy=SuccessPolicy(d.get("success_policy", "AllWorkers")),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSpec:
+    """One replica group (Master/Worker analog).
+
+    ``command`` is the container entrypoint (argv). ``env`` is merged under
+    the orchestrator's wiring (the wiring wins). ``tpu`` is the accelerator
+    claim used for gang placement and for ``JAX_LOCAL_DEVICE_IDS``
+    partitioning in CPU simulation.
+    """
+
+    replicas: int = 1
+    command: tuple[str, ...] = ()
+    env: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE
+    tpu: TPURequest = dataclasses.field(default_factory=TPURequest)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ReplicaSpec":
+        return cls(
+            replicas=int(d.get("replicas", 1)),
+            command=tuple(d.get("command", ())),
+            env=dict(d.get("env", {})),
+            restart_policy=RestartPolicy(d.get("restart_policy", "OnFailure")),
+            tpu=TPURequest.from_dict(d.get("tpu", {})),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "replicas": self.replicas,
+            "command": list(self.command),
+            "env": dict(self.env),
+            "restart_policy": self.restart_policy.value,
+            "tpu": dataclasses.asdict(self.tpu),
+        }
+
+
+#: Replica-type names that carry rank 0 (coordinator placement), in priority
+#: order — mirrors master/chief-first ordering in the reference controllers.
+COORDINATOR_TYPES = ("master", "chief", "launcher")
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """The JAXJob object (metadata + spec)."""
+
+    name: str
+    replicas: dict[str, ReplicaSpec]
+    run_policy: RunPolicy = dataclasses.field(default_factory=RunPolicy)
+    mesh: MeshSpec | None = None
+    namespace: str = "default"
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    uid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex[:12])
+
+    def __post_init__(self) -> None:
+        if not self.replicas:
+            raise ValueError("JobSpec needs at least one replica group")
+        for rtype, spec in self.replicas.items():
+            if spec.replicas < 1:
+                raise ValueError(f"replica group {rtype!r} needs replicas >= 1")
+            if not spec.command:
+                raise ValueError(f"replica group {rtype!r} needs a command")
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def total_replicas(self) -> int:
+        return sum(r.replicas for r in self.replicas.values())
+
+    def replica_order(self) -> list[str]:
+        """Deterministic rank order: coordinator types first, then others
+        in insertion order — so rank 0 lands on the master analog."""
+        names = list(self.replicas)
+        return sorted(
+            names,
+            key=lambda n: (
+                COORDINATOR_TYPES.index(n.lower())
+                if n.lower() in COORDINATOR_TYPES
+                else len(COORDINATOR_TYPES)
+            ),
+        )
+
+    def global_ranks(self) -> dict[tuple[str, int], int]:
+        """(replica_type, index) → global process id."""
+        out: dict[tuple[str, int], int] = {}
+        rank = 0
+        for rtype in self.replica_order():
+            for i in range(self.replicas[rtype].replicas):
+                out[(rtype, i)] = rank
+                rank += 1
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "JobSpec":
+        mesh = d.get("mesh")
+        return cls(
+            name=d["name"],
+            replicas={
+                k: ReplicaSpec.from_dict(v) for k, v in d["replicas"].items()
+            },
+            run_policy=RunPolicy.from_dict(d.get("run_policy", {})),
+            mesh=MeshSpec.from_dict(mesh) if mesh else None,
+            namespace=d.get("namespace", "default"),
+            labels=dict(d.get("labels", {})),
+            uid=d.get("uid", uuid.uuid4().hex[:12]),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "replicas": {k: v.to_dict() for k, v in self.replicas.items()},
+            "run_policy": {
+                "backoff_limit": self.run_policy.backoff_limit,
+                "active_deadline_seconds": self.run_policy.active_deadline_seconds,
+                "ttl_seconds_after_finished": self.run_policy.ttl_seconds_after_finished,
+                "clean_pod_policy": self.run_policy.clean_pod_policy.value,
+                "scheduling": dataclasses.asdict(self.run_policy.scheduling),
+                "success_policy": self.run_policy.success_policy.value,
+            },
+            "mesh": self.mesh.to_dict() if self.mesh else None,
+            "namespace": self.namespace,
+            "labels": dict(self.labels),
+            "uid": self.uid,
+        }
+
+
+class WorkerPhase(str, enum.Enum):
+    """Pod-phase analog for a gang worker process."""
+
+    PENDING = "Pending"       # created, not yet placed
+    SCHEDULED = "Scheduled"   # gang-admitted, awaiting start
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class WorkerStatus:
+    """The "pod" record the reconciler diffs against (desired vs actual)."""
+
+    job_uid: str
+    replica_type: str
+    index: int
+    phase: WorkerPhase = WorkerPhase.PENDING
+    restarts: int = 0
+    exit_code: int | None = None
+    pid: int | None = None
+    slice_id: str | None = None  # placement decision from the gang scheduler
+    message: str = ""
+
+    @property
+    def key(self) -> str:
+        return worker_key(self.job_uid, self.replica_type, self.index)
+
+    @property
+    def finished(self) -> bool:
+        return self.phase in (WorkerPhase.SUCCEEDED, WorkerPhase.FAILED)
+
+
+def worker_key(job_uid: str, rtype: str, index: int) -> str:
+    return f"{job_uid}/{rtype}-{index}"
+
+
+@dataclasses.dataclass
+class JobStatus:
+    """Aggregated status (the CRD .status analog)."""
+
+    conditions: list[JobCondition] = dataclasses.field(default_factory=list)
+    replica_statuses: dict[str, dict[str, int]] = dataclasses.field(default_factory=dict)
+    restart_count: int = 0
+    start_time: float | None = None
+    completion_time: float | None = None
+
+    #: Phase precedence (most decisive first) and which condition types a
+    #: newly-True condition switches off — the reference's one-entry-per-type
+    #: convention with status flags.
+    _PRECEDENCE = (
+        JobConditionType.FAILED,
+        JobConditionType.SUCCEEDED,
+        JobConditionType.RESTARTING,
+        JobConditionType.RUNNING,
+        JobConditionType.QUEUED,
+        JobConditionType.CREATED,
+    )
+    _EXCLUSIVE = {
+        JobConditionType.RUNNING: (
+            JobConditionType.RESTARTING,
+            JobConditionType.QUEUED,
+        ),
+        JobConditionType.RESTARTING: (JobConditionType.RUNNING,),
+        JobConditionType.SUCCEEDED: (
+            JobConditionType.RUNNING,
+            JobConditionType.RESTARTING,
+            JobConditionType.QUEUED,
+        ),
+        JobConditionType.FAILED: (
+            JobConditionType.RUNNING,
+            JobConditionType.RESTARTING,
+            JobConditionType.QUEUED,
+        ),
+    }
+
+    def condition(self) -> JobCondition | None:
+        """The active condition of highest precedence (the job's phase)."""
+        active = {c.type: c for c in self.conditions if c.status}
+        for ctype in self._PRECEDENCE:
+            if ctype in active:
+                return active[ctype]
+        return None
+
+    def has_condition(self, ctype: JobConditionType) -> bool:
+        return any(c.type is ctype for c in self.conditions)
+
+    @property
+    def phase(self) -> str:
+        c = self.condition()
+        return c.type.value if c else "Unknown"
+
+    @property
+    def finished(self) -> bool:
+        return self.has_condition(JobConditionType.SUCCEEDED) or self.has_condition(
+            JobConditionType.FAILED
+        )
+
+    def push(self, ctype: JobConditionType, reason: str = "", message: str = "") -> bool:
+        """Set condition ``ctype`` True (one entry per type, K8s-style),
+        switching off mutually exclusive conditions. True if this flipped
+        state (a real transition)."""
+        entry = next((c for c in self.conditions if c.type is ctype), None)
+        transitioned = entry is None or not entry.status or entry.reason != reason
+        if entry is None:
+            self.conditions.append(
+                JobCondition(type=ctype, reason=reason, message=message)
+            )
+        elif transitioned:
+            entry.status = True
+            entry.reason = reason
+            entry.message = message
+            entry.last_transition = time.time()
+        if transitioned:
+            for other in self._EXCLUSIVE.get(ctype, ()):
+                for c in self.conditions:
+                    if c.type is other and c.status:
+                        c.status = False
+                        c.last_transition = time.time()
+        return transitioned

@@ -1,0 +1,138 @@
+"""In-process object store with watch semantics — the apiserver/etcd analog.
+
+The reference control plane is controller-runtime watching the K8s apiserver
+(SURVEY.md §3.1); this dev environment has no cluster (SURVEY.md §0), so the
+store is a thread-safe dict with resource versions and watch queues. The
+reconciler only sees this interface, so a real K8s-backed implementation can
+be swapped in without touching controller logic — the same layering the
+envtest strategy exploits (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Watch event: ADDED / MODIFIED / DELETED."""
+
+    kind: str
+    key: str
+    obj: Any
+    resource_version: int
+
+
+class ObjectStore:
+    """Versioned keyed storage for one object kind, with watches."""
+
+    def __init__(self, name: str = "objects"):
+        self.name = name
+        self._lock = threading.RLock()
+        self._objects: dict[str, Any] = {}
+        self._version = itertools.count(1)
+        self._watchers: list[queue.SimpleQueue[Event]] = []
+
+    # -- CRUD ----------------------------------------------------------- #
+
+    def create(self, key: str, obj: Any) -> None:
+        with self._lock:
+            if key in self._objects:
+                raise KeyError(f"{self.name}/{key} already exists")
+            self._objects[key] = obj
+            self._notify("ADDED", key, obj)
+
+    def update(self, key: str, obj: Any) -> None:
+        with self._lock:
+            if key not in self._objects:
+                raise KeyError(f"{self.name}/{key} not found")
+            self._objects[key] = obj
+            self._notify("MODIFIED", key, obj)
+
+    def upsert(self, key: str, obj: Any) -> None:
+        with self._lock:
+            kind = "MODIFIED" if key in self._objects else "ADDED"
+            self._objects[key] = obj
+            self._notify(kind, key, obj)
+
+    def delete(self, key: str) -> Any | None:
+        with self._lock:
+            obj = self._objects.pop(key, None)
+            if obj is not None:
+                self._notify("DELETED", key, obj)
+            return obj
+
+    def get(self, key: str) -> Any | None:
+        with self._lock:
+            return self._objects.get(key)
+
+    def list(self, prefix: str = "") -> list[tuple[str, Any]]:
+        with self._lock:
+            return [
+                (k, v) for k, v in self._objects.items() if k.startswith(prefix)
+            ]
+
+    def mutate(self, key: str, fn: Callable[[Any], Any | None]) -> Any:
+        """Atomic read-modify-write; ``fn`` may mutate in place or return a
+        replacement. Returns the stored object."""
+        with self._lock:
+            if key not in self._objects:
+                raise KeyError(f"{self.name}/{key} not found")
+            obj = self._objects[key]
+            replacement = fn(obj)
+            if replacement is not None:
+                obj = replacement
+            self._objects[key] = obj
+            self._notify("MODIFIED", key, obj)
+            return obj
+
+    # -- watches -------------------------------------------------------- #
+
+    def watch(self) -> "Watch":
+        """New watch; immediately replays current state as ADDED events
+        (informer list+watch semantics)."""
+        q: queue.SimpleQueue[Event] = queue.SimpleQueue()
+        with self._lock:
+            version = next(self._version)
+            for k, v in self._objects.items():
+                q.put(Event("ADDED", k, v, version))
+            self._watchers.append(q)
+        return Watch(self, q)
+
+    def _unwatch(self, q: queue.SimpleQueue) -> None:
+        with self._lock:
+            if q in self._watchers:
+                self._watchers.remove(q)
+
+    def _notify(self, kind: str, key: str, obj: Any) -> None:
+        version = next(self._version)
+        for q in self._watchers:
+            q.put(Event(kind, key, obj, version))
+
+
+class Watch:
+    def __init__(self, store: ObjectStore, q: queue.SimpleQueue):
+        self._store = store
+        self._q = q
+        self._stopped = threading.Event()
+
+    def __iter__(self) -> Iterator[Event]:
+        while not self._stopped.is_set():
+            try:
+                yield self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+
+    def poll(self, timeout: float = 0.0) -> Event | None:
+        try:
+            return self._q.get(timeout=timeout) if timeout else self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._store._unwatch(self._q)

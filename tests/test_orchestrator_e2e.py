@@ -1,0 +1,274 @@
+"""Control-plane e2e: real subprocess gangs through the full reconcile loop.
+
+The envtest-analog tier (SURVEY.md §4): submit JobSpecs to a LocalCluster,
+assert the condition state machine, restart policies, gang queueing, TTL —
+with real processes but trivial (non-JAX) payloads so each test is fast.
+"""
+
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.orchestrator import (
+    CleanPodPolicy,
+    JobConditionType as CT,
+    JobSpec,
+    LocalCluster,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    SuccessPolicy,
+    TPURequest,
+    TrainingClient,
+)
+from kubeflow_tpu.orchestrator.resources import Fleet
+from kubeflow_tpu.orchestrator.spec import WorkerPhase
+
+PY = sys.executable
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = LocalCluster(
+        fleet=Fleet.homogeneous(2, "2x2"),
+        base_dir=str(tmp_path),
+        restart_backoff_base=0.05,
+        resync_period=0.05,
+    )
+    with c:
+        yield c
+
+
+def _job(name, code="pass", replicas=2, chips=1, **run_kw):
+    return JobSpec(
+        name=name,
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=replicas,
+                command=(PY, "-c", code),
+                tpu=TPURequest(chips=chips),
+            )
+        },
+        run_policy=RunPolicy(**run_kw),
+    )
+
+
+def _types(status):
+    return [c.type for c in status.conditions]
+
+
+def test_job_succeeds_with_condition_flow(cluster):
+    uid = cluster.submit(_job("ok", "print('hello from worker')"))
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Succeeded"
+    seen = _types(status)
+    assert seen[0] is CT.CREATED and seen[-1] is CT.SUCCEEDED
+    assert CT.FAILED not in seen
+    assert status.replica_statuses["worker"]["succeeded"] == 2
+    assert "hello from worker" in cluster.logs(uid, "worker", 0)
+
+
+def test_env_contract_visible_to_workers(cluster):
+    code = (
+        "import os,sys;"
+        "print('RANK=%s WORLD=%s COORD=%s TYPE=%s IDX=%s' % ("
+        "os.environ['JAX_PROCESS_ID'], os.environ['JAX_NUM_PROCESSES'],"
+        "os.environ['JAX_COORDINATOR_ADDRESS'], os.environ['KFT_REPLICA_TYPE'],"
+        "os.environ['KFT_REPLICA_INDEX']))"
+    )
+    job = JobSpec(
+        name="env",
+        replicas={
+            "master": ReplicaSpec(replicas=1, command=(PY, "-c", code)),
+            "worker": ReplicaSpec(replicas=2, command=(PY, "-c", code)),
+        },
+    )
+    uid = cluster.submit(job)
+    cluster.wait(uid, timeout=30)
+    assert "RANK=0 WORLD=3" in cluster.logs(uid, "master", 0)
+    assert "RANK=2 WORLD=3" in cluster.logs(uid, "worker", 1)
+    assert "TYPE=worker IDX=1" in cluster.logs(uid, "worker", 1)
+
+
+def test_nonretryable_failure(cluster):
+    job = _job("fail", "raise SystemExit(3)")
+    job.replicas["worker"] = ReplicaSpec(
+        replicas=1,
+        command=(PY, "-c", "raise SystemExit(3)"),
+        restart_policy=RestartPolicy.NEVER,
+    )
+    uid = cluster.submit(job)
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Failed"
+    assert status.condition().reason == "NonRetryableExit"
+    assert status.restart_count == 0
+
+
+def test_exitcode_policy_app_error_fails_fast(cluster):
+    job = JobSpec(
+        name="exitcode",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=1,
+                command=(PY, "-c", "raise SystemExit(7)"),
+                restart_policy=RestartPolicy.EXIT_CODE,
+            )
+        },
+    )
+    uid = cluster.submit(job)
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Failed"
+    assert status.restart_count == 0  # 7 < 128: permanent app error
+
+
+def test_exitcode_policy_signal_death_retries(cluster):
+    # Worker SIGKILLs itself on attempt 0 (exit 137 after normalization),
+    # succeeds on attempt 1 — ExitCode treats 128+ as retryable infra.
+    code = (
+        "import os,signal;"
+        "os.kill(os.getpid(), signal.SIGKILL) "
+        "if os.environ['KFT_ATTEMPT']=='0' else None"
+    )
+    job = JobSpec(
+        name="sigkill",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=1,
+                command=(PY, "-c", code),
+                restart_policy=RestartPolicy.EXIT_CODE,
+            )
+        },
+    )
+    uid = cluster.submit(job)
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Succeeded"
+    assert status.restart_count == 1
+    assert CT.RESTARTING in _types(status)
+
+
+def test_gang_restart_then_success(cluster):
+    # worker-0 fails on attempt 0; gang restart relaunches BOTH members.
+    code = (
+        "import os,sys;"
+        "sys.exit(1 if (os.environ['KFT_REPLICA_INDEX']=='0' "
+        "and os.environ['KFT_ATTEMPT']=='0') else 0)"
+    )
+    uid = cluster.submit(_job("gang-restart", code))
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Succeeded"
+    assert status.restart_count == 1
+    w1 = cluster.workers.get(f"{uid}/worker-1")
+    assert w1.restarts == 1  # the healthy member was restarted too (gang)
+
+
+def test_backoff_limit_exceeded(cluster):
+    uid = cluster.submit(_job("hopeless", "raise SystemExit(1)", backoff_limit=2))
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Failed"
+    assert status.condition().reason == "BackoffLimitExceeded"
+    assert status.restart_count == 2
+
+
+def test_gang_queueing_two_jobs_one_slot(cluster):
+    # Each job wants 8 chips = the whole 2-slice fleet → strictly serial.
+    a = cluster.submit(_job("a", "import time; time.sleep(0.4)", chips=4))
+    b = cluster.submit(_job("b", "import time; time.sleep(0.1)", chips=4))
+    sb = cluster.wait(b, timeout=30)
+    sa = cluster.status(a)
+    assert sa.finished and sa.phase == "Succeeded"
+    assert sb.phase == "Succeeded"
+    assert CT.QUEUED in _types(sb)  # b provably waited
+    # b could only start after a released its claims
+    assert sb.start_time >= sa.completion_time - 0.01
+
+
+def test_unschedulable_timeout(cluster):
+    job = _job("toobig", chips=5)  # 5 chips/worker > any 4-chip slice
+    job.run_policy = RunPolicy(
+        scheduling=SchedulingPolicy(timeout_seconds=0.2)
+    )
+    uid = cluster.submit(job)
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Failed"
+    assert status.condition().reason == "Unschedulable"
+
+
+def test_active_deadline(cluster):
+    uid = cluster.submit(
+        _job("slow", "import time; time.sleep(30)",
+             active_deadline_seconds=0.3)
+    )
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Failed"
+    assert status.condition().reason == "DeadlineExceeded"
+    # cleanPodPolicy killed the sleepers
+    time.sleep(0.3)
+    for key, _w in cluster.workers.list(prefix=f"{uid}/"):
+        assert not cluster.launcher.alive(key)
+
+
+def test_ttl_after_finished(cluster):
+    uid = cluster.submit(_job("ttl", ttl_seconds_after_finished=0.2))
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Succeeded"
+    deadline = time.time() + 10
+    while cluster.get(uid) is not None and time.time() < deadline:
+        time.sleep(0.05)
+    assert cluster.get(uid) is None
+    assert cluster.workers.list(prefix=f"{uid}/") == []
+
+
+def test_delete_running_job(cluster):
+    uid = cluster.submit(_job("doomed", "import time; time.sleep(30)"))
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        ws = cluster.workers.list(prefix=f"{uid}/")
+        if ws and all(w.phase is WorkerPhase.RUNNING for _, w in ws):
+            break
+        time.sleep(0.05)
+    cluster.delete(uid)
+    deadline = time.time() + 10
+    while cluster.get(uid) is not None and time.time() < deadline:
+        time.sleep(0.05)
+    assert cluster.get(uid) is None
+
+
+def test_rank0_success_policy_kills_stragglers(cluster):
+    job = JobSpec(
+        name="rank0",
+        replicas={
+            "master": ReplicaSpec(replicas=1, command=(PY, "-c", "pass")),
+            "worker": ReplicaSpec(
+                replicas=1, command=(PY, "-c", "import time; time.sleep(30)")
+            ),
+        },
+        run_policy=RunPolicy(
+            success_policy=SuccessPolicy.RANK0,
+            clean_pod_policy=CleanPodPolicy.RUNNING,
+        ),
+    )
+    uid = cluster.submit(job)
+    status = cluster.wait(uid, timeout=30)
+    assert status.phase == "Succeeded"
+    assert status.condition().reason == "Rank0Succeeded"
+    time.sleep(0.3)
+    assert not cluster.launcher.alive(f"{uid}/worker-0")
+
+
+def test_training_client_surface(cluster):
+    client = TrainingClient(cluster)
+    client.train("sdk-job", module="json.tool", args=("--help",), num_workers=1)
+    status = client.wait_for_job_conditions("sdk-job", timeout=30)
+    assert status.phase == "Succeeded"
+    assert "json" in client.get_job_logs("sdk-job")
+    with pytest.raises(ValueError):
+        client.train("sdk-job", module="json.tool")  # duplicate name
+    client.delete_job("sdk-job")
+    deadline = time.time() + 10
+    while time.time() < deadline and any(
+        s.name == "sdk-job" for s in client.list_jobs()
+    ):
+        time.sleep(0.05)
+    assert all(s.name != "sdk-job" for s in client.list_jobs())
