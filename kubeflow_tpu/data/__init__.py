@@ -1,0 +1,7 @@
+"""Input pipeline: synthetic datasets and sharded host iterators."""
+
+from kubeflow_tpu.data.synthetic import (  # noqa: F401
+    ClassPrototypeDataset,
+    TokenLMDataset,
+    local_shard_iterator,
+)

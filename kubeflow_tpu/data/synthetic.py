@@ -1,0 +1,97 @@
+"""Deterministic synthetic datasets (zero-egress environment: no downloads).
+
+Learnable-by-construction stand-ins for the reference examples' datasets
+(MNIST for config 1, CIFAR-10 for config 2, token streams for BERT/LM —
+SURVEY.md §6): each class has a fixed random prototype and samples are
+noisy prototypes, so a real model's loss demonstrably falls while shapes,
+dtypes and pipelines match the real thing. Fully seeded: the same (seed,
+epoch, index) yields the same example on every host — which is what makes
+*sharded* iteration correct without any cross-host coordination.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassPrototypeDataset:
+    """Image-classification surrogate (MNIST: 28x28x1/10, CIFAR: 32x32x3/10)."""
+
+    image_shape: tuple[int, ...] = (28, 28, 1)
+    num_classes: int = 10
+    noise: float = 0.8
+    seed: int = 0
+
+    def prototypes(self) -> np.ndarray:
+        rng = np.random.RandomState(self.seed)
+        return rng.randn(self.num_classes, *self.image_shape).astype(np.float32)
+
+    def batch(self, batch_size: int, *, step: int, offset: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """Deterministic batch for (step, offset): images NHWC f32, labels i32."""
+        rng = np.random.RandomState(
+            (self.seed * 1_000_003 + step * 997 + offset) % (2**31 - 1)
+        )
+        labels = rng.randint(0, self.num_classes, size=batch_size)
+        protos = self.prototypes()[labels]
+        x = protos + self.noise * rng.randn(*protos.shape).astype(np.float32)
+        return x.astype(np.float32), labels.astype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenLMDataset:
+    """Language-model surrogate: order-k Markov token stream — has real
+    structure (so LM loss falls below uniform entropy) without any corpus."""
+
+    vocab_size: int = 512
+    seq_len: int = 128
+    seed: int = 0
+    branching: int = 4  # successors per token: lower = more learnable
+
+    def _table(self) -> np.ndarray:
+        rng = np.random.RandomState(self.seed + 7)
+        return rng.randint(
+            0, self.vocab_size, size=(self.vocab_size, self.branching)
+        )
+
+    def batch(self, batch_size: int, *, step: int, offset: int = 0) -> dict:
+        rng = np.random.RandomState(
+            (self.seed * 999_983 + step * 1009 + offset * 13) % (2**31 - 1)
+        )
+        table = self._table()
+        toks = np.empty((batch_size, self.seq_len + 1), dtype=np.int32)
+        toks[:, 0] = rng.randint(0, self.vocab_size, size=batch_size)
+        choices = rng.randint(0, self.branching, size=(batch_size, self.seq_len))
+        for t in range(self.seq_len):
+            toks[:, t + 1] = table[toks[:, t], choices[:, t]]
+        return {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+def local_shard_iterator(
+    dataset,
+    global_batch: int,
+    *,
+    process_index: int | None = None,
+    process_count: int | None = None,
+    start_step: int = 0,
+) -> Iterator:
+    """Each host draws only its shard of every global batch.
+
+    Determinism contract: host p of P takes ``offset=p`` of a batch that is
+    globally defined by ``step`` — no host ever materializes the full batch
+    (the input-pipeline discipline multi-host TPU training requires).
+    """
+    import jax
+
+    p = jax.process_index() if process_index is None else process_index
+    n = jax.process_count() if process_count is None else process_count
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} hosts")
+    local = global_batch // n
+    step = start_step
+    while True:
+        yield dataset.batch(local, step=step, offset=p)
+        step += 1

@@ -1,0 +1,1 @@
+"""Runnable JAXJob entrypoints — the analogs of the reference's examples/."""

@@ -1,0 +1,3 @@
+"""Model zoo (flax linen), one family per reference benchmark config."""
+
+from kubeflow_tpu.models.mnist_cnn import MnistCNN  # noqa: F401

@@ -1,0 +1,59 @@
+"""MNIST CNN — the BASELINE config-1 model.
+
+Architecture mirrors the reference example's small CNN (upstream analog
+[training-operator] examples/pytorch/mnist/mnist.py: two conv blocks + two
+dense — UNVERIFIED, mount empty, SURVEY.md §0), expressed as flax linen with
+TPU-friendly defaults (NHWC, bf16-able, channel sizes that tile onto the
+MXU/VPU lanes).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def make_loss_fn(model: MnistCNN):
+    """(params, (images, labels), rng) → (loss, {accuracy})."""
+
+    def loss_fn(params, batch, rng):
+        del rng
+        images, labels = batch
+        logits = model.apply({"params": params}, images)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, {"accuracy": acc}
+
+    return loss_fn
+
+
+def make_init_fn(model: MnistCNN, image_shape=(28, 28, 1)):
+    def init_params(rng):
+        dummy = jnp.zeros((1, *image_shape), jnp.float32)
+        return model.init(rng, dummy)["params"]
+
+    return init_params

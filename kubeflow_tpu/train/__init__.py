@@ -1,0 +1,5 @@
+"""SPMD training plane: loop, checkpointing, metric writers."""
+
+from kubeflow_tpu.train.loop import TrainConfig, Trainer  # noqa: F401
+from kubeflow_tpu.train.metrics import MetricWriter  # noqa: F401
+from kubeflow_tpu.train.checkpoint import CheckpointConfig, Checkpointer  # noqa: F401

@@ -1,0 +1,86 @@
+"""Orbax checkpoint/restore: sharding-aware, multi-host, async-capable.
+
+The reference platform leaves training checkpoints entirely to user code
+(torch.save to PVC — SURVEY.md §5.4); TPU-natively this is a first-class
+subsystem because checkpoint-restart IS the elasticity model for static SPMD
+worlds (SURVEY.md §5.3). Key capability: restore onto a *different* mesh
+shape than the one that saved (elastic-by-restart after losing a slice) —
+Orbax re-shards on load given target shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    directory: str
+    save_every_steps: int = 100
+    max_to_keep: int = 3
+    async_save: bool = True
+
+
+class Checkpointer:
+    """Thin lifecycle wrapper over ``ocp.CheckpointManager``."""
+
+    def __init__(self, config: CheckpointConfig):
+        self.config = config
+        path = Path(config.directory).absolute()
+        path.mkdir(parents=True, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=config.max_to_keep,
+            save_interval_steps=config.save_every_steps,
+            enable_async_checkpointing=config.async_save,
+        )
+        self._mgr = ocp.CheckpointManager(path, options=options)
+
+    # ------------------------------------------------------------------ #
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Save if the interval policy says so (or ``force``). Async when
+        configured — overlaps the HBM→host copy with the next steps."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, target_state: Any, step: int | None = None) -> Any:
+        """Restore into the shardings of ``target_state`` (an abstract or
+        concrete pytree). Because the target carries its own NamedShardings,
+        restoring onto a different mesh shape than the writer's is exactly
+        the same call — the elastic-restart path."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.config.directory}"
+            )
+        abstract = jax.tree_util.tree_map(_abstractify, target_state)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        """Block until async saves are durable (call before exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _abstractify(x: Any) -> Any:
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    return x

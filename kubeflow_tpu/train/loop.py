@@ -1,0 +1,265 @@
+"""The SPMD training loop: pjit-sharded steps over a MeshSpec.
+
+The data-plane analog of the reference's ``DDP(model); loss.backward();
+allreduce; optimizer.step()`` hot loop (SURVEY.md §3.1): here the whole step
+is ONE jitted SPMD program — XLA emits the gradient psum onto ICI from the
+sharding layout (params replicated/sharded per rules, batch sharded on the
+data axes), so there is no explicit allreduce call to schedule or bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections.abc import Iterator
+from typing import Any, Callable, Iterable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.core.mesh import Axis, MeshSpec, build_mesh, per_device_batch
+from kubeflow_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+from kubeflow_tpu.train.metrics import MetricWriter
+
+logger = logging.getLogger(__name__)
+
+#: batch pytrees are sharded over the data-like axes on dim 0.
+BATCH_SPEC = P((Axis.DATA, Axis.FSDP))
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState + a dropout/noise RNG folded per step."""
+
+    rng: jax.Array
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    mesh: MeshSpec
+    global_batch: int
+    steps: int
+    log_every: int = 10
+    seed: int = 0
+    checkpoint: CheckpointConfig | None = None
+    resume: bool = True
+    metrics_logdir: str | None = None
+    donate_state: bool = True
+
+
+class Trainer:
+    """Generic SPMD trainer.
+
+    ``loss_fn(params, batch, rng) -> (loss, aux_dict)`` — differentiated on
+    arg 0. ``init_params(rng) -> params``. ``state_spec_fn`` maps the param
+    tree to PartitionSpecs (None = fully replicated = pure DP); FSDP/TP rules
+    from ``kubeflow_tpu.parallel`` plug in here.
+    """
+
+    def __init__(
+        self,
+        *,
+        init_params: Callable[[jax.Array], Any],
+        loss_fn: Callable[[Any, Any, jax.Array], tuple[jax.Array, Mapping[str, Any]]],
+        optimizer: Any,
+        config: TrainConfig,
+        param_spec_fn: Callable[[Any], Any] | None = None,
+    ):
+        self.config = config
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.init_params_fn = init_params
+        self.param_spec_fn = param_spec_fn
+        self.mesh: Mesh = build_mesh(config.mesh)
+        self.batch_sharding = NamedSharding(self.mesh, BATCH_SPEC)
+        self.repl = NamedSharding(self.mesh, P())
+        self._step_fn = None
+        self._state_sharding = None
+
+    # ------------------------------------------------------------------ #
+
+    def init_state(self) -> TrainState:
+        """Initialize params ON the mesh with their target shardings (jit of
+        init so large params materialize sharded, never on one host)."""
+        rng = jax.random.PRNGKey(self.config.seed)
+
+        def mk(rng):
+            params = self.init_params_fn(rng)
+            return TrainState.create(
+                apply_fn=None,
+                params=params,
+                tx=self.optimizer,
+                rng=rng,
+            )
+
+        if self.param_spec_fn is None:
+            out_shardings = self.repl
+        else:
+            abstract = jax.eval_shape(mk, rng)
+            specs = self._specs_for(abstract)
+            out_shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), specs
+            )
+        state = jax.jit(mk, out_shardings=out_shardings)(rng)
+        self._state_sharding = jax.tree_util.tree_map(lambda x: x.sharding, state)
+        return state
+
+    def _specs_for(self, abstract_state) -> Any:
+        """PartitionSpec tree for the full TrainState: params per rules,
+        optimizer-state subtrees that mirror the params structure get the
+        same specs (ZeRO-style colocation), everything else replicated.
+
+        Matching is *structural* (a subtree with the params' treedef), not
+        by shape/dtype — same-shaped params with different specs must not
+        collide."""
+        param_specs = jax.tree_util.tree_map(
+            lambda s: s if isinstance(s, P) else (P() if s is None else P(*s)),
+            self.param_spec_fn(abstract_state.params),
+            is_leaf=lambda x: x is None or isinstance(x, (P, tuple)),
+        )
+        if jax.tree_util.tree_structure(param_specs) != jax.tree_util.tree_structure(
+            abstract_state.params
+        ):
+            raise ValueError(
+                "param_spec_fn must return a tree with the params' structure"
+            )
+        params_def = jax.tree_util.tree_structure(abstract_state.params)
+
+        def is_params_like(node) -> bool:
+            try:
+                return jax.tree_util.tree_structure(node) == params_def
+            except Exception:  # noqa: BLE001 — unhashable/odd nodes aren't params
+                return False
+
+        return jax.tree_util.tree_map(
+            lambda node: (
+                param_specs
+                if is_params_like(node)
+                else jax.tree_util.tree_map(lambda _: P(), node)
+            ),
+            abstract_state,
+            is_leaf=is_params_like,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def _build_step(self, state: TrainState):
+        loss_fn = self.loss_fn
+
+        def step(state: TrainState, batch):
+            rng = jax.random.fold_in(state.rng, state.step)
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch, rng
+            )
+            new_state = state.apply_gradients(grads=grads)
+            metrics = {"loss": loss, **aux}
+            return new_state, metrics
+
+        state_shardings = self._state_sharding
+        return jax.jit(
+            step,
+            in_shardings=(state_shardings, self.batch_sharding),
+            out_shardings=(state_shardings, self.repl),
+            donate_argnums=(0,) if self.config.donate_state else (),
+        )
+
+    def global_batch_array(self, local_batch) -> Any:
+        """Process-local numpy batch shards → one global sharded pytree."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                self.batch_sharding, np.asarray(x)
+            ),
+            local_batch,
+        )
+
+    def local_batch_size(self) -> int:
+        return self.config.global_batch // jax.process_count()
+
+    # ------------------------------------------------------------------ #
+
+    def fit(
+        self,
+        data: Iterator[Any] | Iterable[Any] | Callable[[int], Iterator[Any]],
+        *,
+        writer: MetricWriter | None = None,
+        hooks: list[Callable[[int, Mapping[str, float]], None]] | None = None,
+    ) -> tuple[TrainState, list[dict]]:
+        """Train for ``config.steps``.
+
+        ``data`` is ideally a *factory* ``start_step -> iterator`` so that a
+        checkpoint resume continues the stream where training resumes rather
+        than replaying batch 0; a plain iterator is accepted for
+        non-resuming runs.
+        """
+        cfg = self.config
+        per_device_batch(cfg.global_batch, cfg.mesh)  # validate divisibility
+        own_writer = writer is None
+        writer = writer or MetricWriter(
+            cfg.metrics_logdir, is_writer=jax.process_index() == 0
+        )
+
+        state = self.init_state()
+        ckpt: Checkpointer | None = None
+        start_step = 0
+        if cfg.checkpoint is not None:
+            ckpt = Checkpointer(cfg.checkpoint)
+            if cfg.resume and ckpt.latest_step() is not None:
+                state = ckpt.restore(state)
+                start_step = int(jax.device_get(state.step))
+                logger.info("resumed from checkpoint at step %d", start_step)
+        if callable(data) and not hasattr(data, "__next__"):
+            it = iter(data(start_step))
+        else:
+            if start_step and not isinstance(data, Iterator):
+                logger.warning(
+                    "resuming at step %d with a plain iterator: the data "
+                    "stream restarts from its beginning; pass a "
+                    "start_step->iterator factory for a faithful resume",
+                    start_step,
+                )
+            it = iter(data)
+
+        step_fn = self._build_step(state)
+        history: list[dict] = []
+        t_last = time.perf_counter()
+        last_logged = start_step
+        try:
+            for step in range(start_step, cfg.steps):
+                state, metrics = step_fn(state, self.global_batch_array(next(it)))
+                if ckpt is not None:
+                    ckpt.save(step + 1, state)
+                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                    m = {k: float(v) for k, v in metrics.items()}
+                    now = time.perf_counter()
+                    m["steps_per_sec"] = (step + 1 - last_logged) / (now - t_last)
+                    t_last = now
+                    last_logged = step + 1
+                    writer.write(step + 1, m)
+                    history.append({"step": step + 1, **m})
+                    for h in hooks or ():
+                        h(step + 1, m)
+        finally:
+            if ckpt is not None:
+                self._final_save(ckpt, state)
+                ckpt.close()
+            if own_writer:
+                writer.close()
+        return state, history
+
+    @staticmethod
+    def _final_save(ckpt: Checkpointer, state: TrainState) -> None:
+        """Best-effort final checkpoint; with donated buffers the state may
+        be dead if the last step raised — never mask the original error."""
+        leaves = jax.tree_util.tree_leaves(state)
+        if any(
+            isinstance(x, jax.Array) and x.is_deleted() for x in leaves
+        ):
+            logger.warning("skipping final checkpoint: state buffers donated "
+                           "to a failed step")
+            return
+        final_step = int(jax.device_get(state.step))
+        if ckpt.latest_step() != final_step:
+            ckpt.save(final_step, state, force=True)

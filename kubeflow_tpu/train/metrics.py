@@ -1,0 +1,109 @@
+"""Metric writers: stdout (tuner-scrapable), JSONL, optional TensorBoard.
+
+The reference's clever observability bit is the Katib metrics-collector
+sidecar that regex-parses trial stdout (SURVEY.md §5.5) — user code needs no
+SDK. We emit the same ``key=value`` stdout format our tuner's collector
+scrapes (``kubeflow_tpu.tune``), plus a JSONL stream for programmatic
+readers, plus TensorBoard events when a writer is available (the TFEvents
+path of the reference collector).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Any, IO, Mapping
+
+#: stdout format, one line per step: ``step=3 loss=1.23 accuracy=0.9``
+#: (floats rendered with repr-precision; scrapers parse ``(\w+)=([^ ]+)``).
+
+
+class MetricWriter:
+    """Rank-0-gated multi-sink metric writer."""
+
+    def __init__(
+        self,
+        logdir: str | Path | None = None,
+        *,
+        is_writer: bool = True,
+        stdout: IO[str] | None = None,
+        tensorboard: bool = False,
+    ):
+        self.is_writer = is_writer
+        self.logdir = Path(logdir) if logdir else None
+        self._stdout = stdout or sys.stdout
+        self._jsonl: IO[str] | None = None
+        self._tb = None
+        if not self.is_writer:
+            return
+        if self.logdir:
+            self.logdir.mkdir(parents=True, exist_ok=True)
+            self._jsonl = open(self.logdir / "metrics.jsonl", "a")
+        if tensorboard and self.logdir:
+            try:  # torch's pure-python event writer; optional
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=str(self.logdir / "tb"))
+            except Exception:  # noqa: BLE001 — TB is best-effort
+                self._tb = None
+
+    def write(self, step: int, metrics: Mapping[str, Any]) -> None:
+        if not self.is_writer:
+            return
+        scalars = {k: _to_scalar(v) for k, v in metrics.items()}
+        line = " ".join(
+            [f"step={step}"] + [f"{k}={v:.6g}" for k, v in scalars.items()]
+        )
+        print(line, file=self._stdout, flush=True)
+        if self._jsonl:
+            self._jsonl.write(
+                json.dumps({"step": step, "time": time.time(), **scalars}) + "\n"
+            )
+            self._jsonl.flush()
+        if self._tb:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, v, step)
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+            self._jsonl = None
+        if self._tb:
+            self._tb.close()
+            self._tb = None
+
+    def __enter__(self) -> "MetricWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _to_scalar(v: Any) -> float:
+    """Device arrays → python floats (blocks; call off the hot path)."""
+    try:
+        return float(v)
+    except TypeError:
+        import numpy as np
+
+        return float(np.asarray(v).mean())
+
+
+def parse_stdout_metrics(text: str) -> list[dict[str, float]]:
+    """Inverse of ``write``: scrape ``key=value`` lines (the collector's
+    regex format). Non-numeric tokens are skipped."""
+    import re
+
+    out = []
+    for line in text.splitlines():
+        found = dict()
+        for k, v in re.findall(r"(\w+)=([^\s]+)", line):
+            try:
+                found[k] = float(v)
+            except ValueError:
+                continue
+        if "step" in found and len(found) > 1:
+            out.append(found)
+    return out

@@ -1,0 +1,69 @@
+"""MINIMUM END-TO-END SLICE (SURVEY.md §7 step 3, BASELINE config 1 analog).
+
+JobSpec(mnist, workers=2) → gang launcher → 2 processes → jax.distributed
+rendezvous → DP training over an 8-device (2 hosts x 4) gloo-backed mesh →
+metrics on stdout → checkpoint → Succeeded condition. This is the kind-e2e
+analog: real processes, real cross-process collectives, no cluster.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.orchestrator import (
+    JobSpec,
+    LocalCluster,
+    ReplicaSpec,
+    TPURequest,
+)
+from kubeflow_tpu.orchestrator.envwire import WiringConfig
+from kubeflow_tpu.orchestrator.resources import Fleet
+from kubeflow_tpu.train.metrics import parse_stdout_metrics
+
+REPO = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+
+
+@pytest.mark.slow
+def test_jaxjob_mnist_two_process_gang(tmp_path):
+    cluster = LocalCluster(
+        fleet=Fleet.homogeneous(2, "2x2"),
+        wiring=WiringConfig(platform="cpu_sim", devices_per_worker=4),
+        base_dir=str(tmp_path),
+        resync_period=0.05,
+    )
+    with cluster:
+        job = JobSpec(
+            name="mnist-dp",
+            replicas={
+                "worker": ReplicaSpec(
+                    replicas=2,
+                    command=(
+                        PY, "-m", "kubeflow_tpu.examples.mnist",
+                        "--steps", "6", "--global-batch", "32",
+                        "--log-every", "2", "--lr", "3e-3",
+                        "--checkpoint-dir", str(tmp_path / "ckpt"),
+                        "--checkpoint-every", "3",
+                    ),
+                    env={"PYTHONPATH": REPO},
+                    tpu=TPURequest(chips=4),
+                )
+            },
+        )
+        uid = cluster.submit(job)
+        status = cluster.wait(uid, timeout=600)
+        log0 = cluster.logs(uid, "worker", 0)
+        log1 = cluster.logs(uid, "worker", 1)
+        assert status.phase == "Succeeded", f"rank0 log:\n{log0}\nrank1:\n{log1}"
+
+        # world formed: every process saw 4 local / 8 global devices
+        assert "4 local / 8 global" in log0 and "4 local / 8 global" in log1
+        # rank-0 gating: metrics only on worker-0's stdout
+        metrics = parse_stdout_metrics(log0)
+        assert [m["step"] for m in metrics] == [2, 4, 6]
+        assert metrics[-1]["loss"] < metrics[0]["loss"]
+        assert parse_stdout_metrics(log1) == []
+        assert "final_loss=" in log0
+        # checkpoint written and readable
+        assert any((tmp_path / "ckpt").iterdir())
