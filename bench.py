@@ -1,0 +1,141 @@
+"""Headline benchmark — BASELINE config 1: JAXJob-vs-PyTorchJob MNIST step time.
+
+Measures OUR steady-state MNIST CNN train-step time on the local accelerator
+(TPU v5e under the driver) and, for ``vs_baseline``, measures the REFERENCE
+config's data plane in-process: the same CNN trained by torch on CPU (the
+reference example runs with the gloo CPU backend — SURVEY.md §6 row 1,
+BASELINE.json configs[0]; no published numbers exist, so both sides are
+measured here).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": <ms>, "unit": "ms", "vs_baseline": <speedup>}
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+GLOBAL_BATCH = 64
+WARMUP = 5
+TIMED = 30
+TORCH_TIMED = 10
+
+
+def bench_jax() -> float:
+    """Our side: DP train step over all local devices. Returns ms/step."""
+    import jax
+    import optax
+
+    from kubeflow_tpu.core.mesh import MeshSpec
+    from kubeflow_tpu.data.synthetic import ClassPrototypeDataset, local_shard_iterator
+    from kubeflow_tpu.models.mnist_cnn import MnistCNN, make_init_fn, make_loss_fn
+    from kubeflow_tpu.train.loop import TrainConfig, Trainer
+
+    model = MnistCNN()
+    trainer = Trainer(
+        init_params=make_init_fn(model),
+        loss_fn=make_loss_fn(model),
+        optimizer=optax.adam(1e-3),
+        config=TrainConfig(
+            mesh=MeshSpec.data_parallel(jax.device_count()),
+            global_batch=GLOBAL_BATCH,
+            steps=WARMUP + TIMED,
+            log_every=10_000,  # silent
+        ),
+    )
+    state = trainer.init_state()
+    step_fn = trainer._build_step(state)
+    data = local_shard_iterator(ClassPrototypeDataset(), GLOBAL_BATCH)
+    batches = [trainer.global_batch_array(next(data)) for _ in range(8)]
+
+    for i in range(WARMUP):
+        state, m = step_fn(state, batches[i % len(batches)])
+    jax.block_until_ready(m)
+
+    times = []
+    for i in range(TIMED):
+        t0 = time.perf_counter()
+        state, m = step_fn(state, batches[i % len(batches)])
+        jax.block_until_ready(m)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) * 1e3
+
+
+def bench_torch_reference() -> float:
+    """Reference side: same CNN/batch, torch CPU (the gloo-backend config's
+    numerics on this host). Returns ms/step."""
+    import numpy as np
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    torch.manual_seed(0)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2d(1, 32, 3, padding=1)
+            self.c2 = nn.Conv2d(32, 64, 3, padding=1)
+            self.f1 = nn.Linear(64 * 7 * 7, 128)
+            self.f2 = nn.Linear(128, 10)
+
+        def forward(self, x):
+            x = F.max_pool2d(F.relu(self.c1(x)), 2)
+            x = F.max_pool2d(F.relu(self.c2(x)), 2)
+            x = x.flatten(1)
+            return self.f2(F.relu(self.f1(x)))
+
+    from kubeflow_tpu.data.synthetic import ClassPrototypeDataset
+
+    ds = ClassPrototypeDataset()
+    net = Net()
+    opt = torch.optim.Adam(net.parameters(), lr=1e-3)
+
+    def step(i):
+        x, y = ds.batch(GLOBAL_BATCH, step=i)
+        xt = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+        yt = torch.from_numpy(y.astype(np.int64))
+        opt.zero_grad()
+        loss = F.cross_entropy(net(xt), yt)
+        loss.backward()
+        opt.step()
+
+    for i in range(3):
+        step(i)
+    times = []
+    for i in range(TORCH_TIMED):
+        t0 = time.perf_counter()
+        step(i)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) * 1e3
+
+
+def main() -> int:
+    jax_ms = bench_jax()
+    torch_ms = bench_torch_reference()
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_cnn_train_step_time",
+                "value": round(jax_ms, 4),
+                "unit": "ms",
+                "vs_baseline": round(torch_ms / jax_ms, 3),
+                "detail": {
+                    "backend": jax.default_backend(),
+                    "devices": jax.device_count(),
+                    "global_batch": GLOBAL_BATCH,
+                    "reference_torch_cpu_ms": round(torch_ms, 4),
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
