@@ -1,0 +1,5 @@
+"""Pallas TPU kernels — the custom-kernel obligations of SURVEY.md §2.8:
+the reference data plane's hand-written CUDA (fused attention et al.) maps to
+Pallas/Mosaic here; everything else rides XLA fusion."""
+
+from kubeflow_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,300 @@
+"""Pallas flash attention (TPU): online-softmax blockwise attention.
+
+The serving-path kernel of the north star (BASELINE config 5: "Pallas
+attention kernel for transformer serving") and the inner kernel of ring
+attention (SURVEY.md §5.7). Design per the TPU kernel playbook
+(/opt/skills/guides/pallas_guide.md):
+
+- grid (batch, heads, q-blocks, kv-blocks); kv innermost and "arbitrary" so
+  the online-softmax accumulator lives in VMEM scratch across kv steps;
+- q/k/v blocks staged HBM→VMEM by pallas_call's pipeline; MXU matmuls with
+  ``preferred_element_type=f32``; VPU for the softmax algebra;
+- causal blocks that are entirely in the future are skipped (predicated);
+- optional segment ids give block-diagonal masking (serving batches,
+  packed sequences);
+- backward: recompute-based VJP in XLA for now (flash backward kernel is a
+  planned upgrade; forward is the serving-latency path).
+
+Returns optionally the (max, logsumexp) residuals, which is what lets
+``kubeflow_tpu.parallel.ring_attention`` merge partial results across ring
+steps.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-but-finite: keeps exp() well-defined on fully-masked rows
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _attn_kernel(
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+    out_ref, lse_ref,
+    acc_ref, m_ref, l_ref,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: skip kv blocks strictly in the future of this q block.
+    q_start = iq * block_q
+    k_start = ik * block_k
+    run = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # (Bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)  # (Bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)  # (Bk, D)
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (Bq, Bk)
+
+        mask = None
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (q_start + rows) >= (k_start + cols)
+        if qseg_ref is not None:
+            qs = qseg_ref[0, 0]  # (Bq,)
+            ks = kseg_ref[0, 0]  # (Bk,)
+            seg = qs[:, None] == ks[None, :]
+            mask = seg if mask is None else (mask & seg)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]                     # (Bq, 1)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)                     # (Bq, Bk)
+        alpha = jnp.exp(m_prev - m_cur)            # (Bq, 1)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_cur
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finish():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros
+        out_ref[0, 0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
+        lse_ref[0, 0] = (m_ref[:, 0:1] + jnp.log(safe_l)).astype(lse_ref.dtype)
+
+
+def _flash_forward(
+    q, k, v, q_segment_ids, kv_segment_ids,
+    *, causal, scale, block_q, block_k, interpret,
+):
+    batch, heads, sq, d = q.shape
+    _, _, skv, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if sq % block_q or skv % block_k:
+        raise ValueError(
+            f"seq lens (q={sq}, kv={skv}) must divide block sizes "
+            f"({block_q}, {block_k}); pad inputs"
+        )
+    nq, nk = sq // block_q, skv // block_k
+
+    impl = functools.partial(
+        _attn_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=nk,
+    )
+    has_seg = q_segment_ids is not None
+    if has_seg:
+        def kernel(q_r, k_r, v_r, qs_r, ks_r, out_r, lse_r, acc, m, l):
+            impl(q_r, k_r, v_r, qs_r, ks_r, out_r, lse_r, acc, m, l)
+    else:
+        def kernel(q_r, k_r, v_r, out_r, lse_r, acc, m, l):
+            impl(q_r, k_r, v_r, None, None, out_r, lse_r, acc, m, l)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda b, h, iq, ik: (b, h, ik, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_seg:
+        # (B, S) → (B, 1, S): TPU block shapes need the trailing two dims
+        # to tile cleanly (1 matches the singleton dim; block divides S).
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_q), lambda b, h, iq, ik: (b, 0, iq))
+        )
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, h, iq, ik: (b, 0, ik))
+        )
+        inputs.extend(
+            [q_segment_ids[:, None, :], kv_segment_ids[:, None, :]]
+        )
+
+    out, lse4 = pl.pallas_call(
+        kernel,
+        grid=(batch, heads, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),   # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*inputs)
+    return out, lse4[..., 0]
+
+
+# --------------------------------------------------------------------------- #
+# public API with recompute VJP
+# --------------------------------------------------------------------------- #
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+)
+def _flash(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k_and_interp):
+    block_k, interpret = block_k_and_interp
+    out, _ = _flash_forward(
+        q, k, v, q_seg, kv_seg,
+        causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k_and_interp):
+    block_k, interpret = block_k_and_interp
+    out, lse = _flash_forward(
+        q, k, v, q_seg, kv_seg,
+        causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(q_seg, kv_seg, causal, scale, block_q, block_k_and_interp,
+               res, dout):
+    q, k, v, out, lse = res
+    qf, kf, vf, doutf = (x.astype(jnp.float32) for x in (q, k, v, dout))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    mask = _full_mask(q.shape, k.shape, q_seg, kv_seg, causal)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])                      # (B,H,Sq,Skv)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, doutf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", doutf, vf)
+    delta = jnp.sum(doutf * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf).astype(q.dtype)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf).astype(k.dtype)
+    dv = dv.astype(v.dtype)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _full_mask(q_shape, k_shape, q_seg, kv_seg, causal):
+    _, _, sq, _ = q_shape
+    _, _, skv, _ = k_shape
+    mask = None
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)[None, None]
+    if q_seg is not None:
+        seg = (q_seg[:, None, :, None] == kv_seg[:, None, None, :])
+        mask = seg if mask is None else (mask & seg)
+    return mask
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    q_segment_ids: jax.Array | None = None,
+    kv_segment_ids: jax.Array | None = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+    return_residuals: bool = False,
+):
+    """Fused attention. Shapes: q (B,H,Sq,D); k/v (B,H,Skv,D).
+
+    ``return_residuals`` additionally returns (lse,) — the per-row
+    log-sum-exp — for cross-block merging (ring attention). Differentiable
+    only in the default (no-residual) form.
+    """
+    if q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"q heads {q.shape[1]} != kv heads {k.shape[1]} "
+            "(repeat kv heads for GQA before calling)"
+        )
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("pass both q_segment_ids and kv_segment_ids or neither")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if return_residuals:
+        out, lse = _flash_forward(
+            q, k, v, q_segment_ids, kv_segment_ids,
+            causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        return out, lse
+    return _flash(
+        q, k, v, q_segment_ids, kv_segment_ids,
+        causal, scale, block_q, (block_k, interpret),
+    )
+
+
+def reference_attention(
+    q, k, v, *, causal=False, scale=None,
+    q_segment_ids=None, kv_segment_ids=None,
+):
+    """Plain-XLA attention; numerics oracle for the kernels and the
+    small-shape fallback."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk",
+        q.astype(jnp.float32), k.astype(jnp.float32),
+    ) * scale
+    mask = _full_mask(q.shape, k.shape, q_segment_ids, kv_segment_ids, causal)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

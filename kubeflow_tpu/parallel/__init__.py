@@ -1,0 +1,16 @@
+"""Parallelism strategies as named mesh axes (SURVEY.md §2.6 matrix).
+
+Every row of the reference stack's strategy table is first-class here:
+
+- DP/FSDP:  ``sharding`` rules (replicate vs shard params over ``fsdp``)
+- TP:       ``sharding`` Megatron-style column/row rules over ``model``
+- PP:       ``pipeline`` GPipe microbatching over ``pipe``
+- SP:       ``ulysses`` all_to_all seq<->heads re-sharding over ``seq``
+- CP:       ``ring_attention`` ppermute KV rotation over ``seq``
+- EP:       ``expert`` all_to_all token dispatch over ``expert``
+"""
+
+from kubeflow_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    transformer_rules,
+)

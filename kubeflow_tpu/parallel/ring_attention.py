@@ -1,0 +1,215 @@
+"""Ring attention: context parallelism over the ``seq`` mesh axis.
+
+SURVEY.md §5.7's headline differentiator. Sequence-sharded Q stays put; the
+KV shards rotate around the ICI ring via ``lax.ppermute`` (torus neighbors →
+each hop is a single physical link), and every rank merges the per-block
+partial attention results with online-softmax algebra. Memory per chip is
+O(S/n · S/n) blockwise — never the full S×S matrix — which is what makes
+million-token contexts fit.
+
+The per-block compute is the Pallas flash kernel
+(``kubeflow_tpu.ops.flash_attention``) with ``return_residuals=True`` — its
+(out, logsumexp) pairs are exactly the mergeable form. The backward pass is
+a second ring sweep: dq accumulates at home, dk/dv accumulate on the
+rotating shard and arrive home after n hops (both passes are n ppermutes of
+the same payload size — communication-optimal for the ring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.core.mesh import Axis
+from kubeflow_tpu.ops.flash_attention import (
+    NEG_INF,
+    flash_attention,
+    reference_attention,
+)
+
+
+def _rotate(x, axis_name: str):
+    """One ring hop: shard i → shard i+1."""
+    n = lax.axis_size(axis_name)
+    return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def _block_flash(q, k, v, *, causal_mode: int, scale, block_q, block_k, interpret):
+    """Partial attention of local q vs one kv shard.
+
+    causal_mode: 0 = full (kv strictly past), 1 = causal diagonal block,
+    2 = skip (kv strictly future). Returns (out, lse)."""
+    B, H, S, D = q.shape
+
+    def full(_):
+        return flash_attention(
+            q, k, v, causal=False, scale=scale,
+            block_q=block_q, block_k=block_k,
+            interpret=interpret, return_residuals=True,
+        )
+
+    def diag(_):
+        return flash_attention(
+            q, k, v, causal=True, scale=scale,
+            block_q=block_q, block_k=block_k,
+            interpret=interpret, return_residuals=True,
+        )
+
+    def skip(_):
+        return (
+            jnp.zeros_like(q),
+            jnp.full((B, H, S), NEG_INF, jnp.float32),
+        )
+
+    return lax.switch(causal_mode, (full, diag, skip), None)
+
+
+def _merge(o, lse, o_t, lse_t):
+    """Online-softmax merge of normalized partials (o, lse)."""
+    lse_new = jnp.logaddexp(lse, lse_t)
+    w = jnp.exp(lse - lse_new)[..., None]
+    w_t = jnp.exp(lse_t - lse_new)[..., None]
+    return o * w + o_t * w_t.astype(o.dtype), lse_new
+
+
+def _ring_fwd_pass(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    o = jnp.zeros_like(q)
+    lse = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    for step in range(n):
+        src = (me - step) % n  # whose kv shard we currently hold
+        if causal:
+            mode = jnp.where(src == me, 1, jnp.where(src < me, 0, 2))
+        else:
+            mode = jnp.int32(0)
+        o_t, lse_t = _block_flash(
+            q, k, v, causal_mode=mode, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        o, lse = _merge(o, lse, o_t, lse_t)
+        if step != n - 1:
+            k = _rotate(k, axis_name)
+            v = _rotate(v, axis_name)
+    return o, lse
+
+
+# --------------------------------------------------------------------------- #
+# custom VJP (operates on LOCAL shards inside shard_map)
+# --------------------------------------------------------------------------- #
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_local(q, k, v, axis_name, causal, scale, blocks, interpret):
+    o, _ = _ring_fwd_pass(
+        q, k, v, axis_name, causal, scale, blocks[0], blocks[1], interpret
+    )
+    return o
+
+
+def _ring_local_fwd(q, k, v, axis_name, causal, scale, blocks, interpret):
+    o, lse = _ring_fwd_pass(
+        q, k, v, axis_name, causal, scale, blocks[0], blocks[1], interpret
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _ring_local_bwd(axis_name, causal, scale, blocks, interpret, res, do):
+    del blocks, interpret  # bwd blocks are whole-shard einsums
+    q, k, v, o, lse = res
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (B,H,S)
+
+    dq = jnp.zeros_like(qf)
+    dk = jnp.zeros_like(k, dtype=jnp.float32)  # rides the ring with k,v
+    dv = jnp.zeros_like(v, dtype=jnp.float32)
+
+    S = q.shape[2]
+    rows = lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (S, S), 1)
+
+    for step in range(n):
+        src = (me - step) % n
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+        if causal:
+            # global causal structure between my q shard and kv shard `src`
+            keep_full = src < me
+            keep_diag = src == me
+            mask = jnp.where(
+                keep_full,
+                jnp.ones((S, S), bool),
+                jnp.where(keep_diag, rows >= cols, jnp.zeros((S, S), bool)),
+            )
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # (B,H,Sq,Skv) — normalized probs
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        if step != n - 1:
+            k = _rotate(k, axis_name)
+            v = _rotate(v, axis_name)
+            dk = _rotate(dk, axis_name)
+            dv = _rotate(dv, axis_name)
+    # after n-1 hops the accumulators sit one hop short of home
+    dk = _rotate(dk, axis_name)
+    dv = _rotate(dv, axis_name)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_local.defvjp(_ring_local_fwd, _ring_local_bwd)
+
+
+def ring_attention_local(
+    q, k, v, *,
+    axis_name: str = Axis.SEQ,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Ring attention on LOCAL seq shards — call inside shard_map where
+    ``axis_name`` is a mesh axis and q/k/v are (B, H, S_local, D)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _ring_local(
+        q, k, v, axis_name, causal, scale, (block_q, block_k), interpret
+    )
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, *,
+    axis_name: str = Axis.SEQ,
+    causal: bool = False,
+    scale: float | None = None,
+    interpret: bool = False,
+):
+    """Global-array convenience wrapper: shards seq over ``axis_name``,
+    batch over data, heads over model."""
+    spec = P(Axis.DATA, Axis.MODEL, axis_name, None)
+
+    def local(q, k, v):
+        return ring_attention_local(
+            q, k, v, axis_name=axis_name, causal=causal,
+            scale=scale, interpret=interpret,
+        )
+
+    fn = jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return fn(q, k, v)

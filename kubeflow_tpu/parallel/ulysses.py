@@ -1,0 +1,82 @@
+"""Ulysses sequence parallelism: all_to_all seq<->heads re-sharding.
+
+The DeepSpeed-Ulysses pattern (SURVEY.md §2.6 SP row), TPU-native: on entry
+each rank holds all heads for a sequence shard; two ``lax.all_to_all``s swap
+to all-sequence/head-shard around a standard (full-sequence) flash kernel,
+then swap back. Cheaper than ring when heads >= ring size and sequence fits
+per-chip after the head split; ring wins beyond that (SURVEY.md §5.7 chooses
+per layer via config).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.core.mesh import Axis
+from kubeflow_tpu.ops.flash_attention import flash_attention
+
+
+def ulysses_attention_local(
+    q, k, v, *,
+    axis_name: str = Axis.SEQ,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Inside shard_map: q/k/v are (B, H, S_local, D); H must divide the
+    axis size. Returns (B, H, S_local, D)."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    H = q.shape[1]
+    if H % n:
+        raise ValueError(
+            f"Ulysses needs heads ({H}) divisible by seq axis size ({n}); "
+            "use ring attention instead"
+        )
+
+    def seq_to_heads(x):  # (B, H, S/n, D) → (B, H/n, S, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def heads_to_seq(x):  # (B, H/n, S, D) → (B, H, S/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    o = flash_attention(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return heads_to_seq(o)
+
+
+def ulysses_attention(
+    q, k, v, mesh: Mesh, *,
+    axis_name: str = Axis.SEQ,
+    causal: bool = False,
+    scale: float | None = None,
+    interpret: bool = False,
+):
+    """Global-array convenience wrapper (batch over data, heads over model,
+    seq over ``axis_name``)."""
+    spec = P(Axis.DATA, Axis.MODEL, axis_name, None)
+
+    def local(q, k, v):
+        return ulysses_attention_local(
+            q, k, v, axis_name=axis_name, causal=causal,
+            scale=scale, interpret=interpret,
+        )
+
+    fn = jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return fn(q, k, v)
