@@ -1,0 +1,133 @@
+"""Pipeline parallelism: SPMD GPipe over the ``pipe`` mesh axis.
+
+The Megatron/DeepSpeed pipeline-engine row of SURVEY.md §2.6, TPU-native:
+instead of P2P sends between per-stage processes, every rank runs the SAME
+program (SPMD); stage s holds its layer shard, microbatch activations hop to
+the next stage with one ``lax.ppermute`` per tick, and bubble ticks are
+predicated out with ``jnp.where``. The whole schedule is differentiable, so
+the 1B1F backward schedule falls out of autodiff (reverse ppermutes) with no
+custom VJP.
+
+Tick layout (GPipe): T = n_micro + n_stages - 1 ticks; at tick t stage s
+works on microbatch (t - s). With n_micro >> n_stages the bubble fraction
+(n_stages-1)/T amortizes away.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.core.mesh import Axis
+
+
+def spmd_pipeline_local(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,  # (n_micro, mb, ...) — consumed by stage 0
+    *,
+    axis_name: str = Axis.PIPE,
+) -> jax.Array:
+    """Run inside shard_map over ``axis_name``.
+
+    ``stage_params`` are THIS stage's params (callers shard a stacked
+    param tree over the axis). Returns (n_micro, mb, ...) outputs (valid on
+    every rank — the last stage's results are broadcast back with a psum
+    over one-hot masking).
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    ticks = n_micro + n - 1
+
+    def tick(carry, t):
+        state, outputs = carry  # state: (mb, ...) activation entering this stage
+        mb_idx = t - s
+        # stage 0 injects a fresh microbatch on ticks 0..n_micro-1
+        inject = jnp.logical_and(s == 0, t < n_micro)
+        x_inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, n_micro - 1), keepdims=False
+        )
+        x_in = jnp.where(inject, x_inject, state)
+        active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active, y, x_in)  # bubble ticks pass through
+        # last stage banks its finished microbatch
+        bank = jnp.logical_and(s == n - 1, active)
+        idx = jnp.clip(mb_idx, 0, n_micro - 1)
+        current = lax.dynamic_index_in_dim(outputs, idx, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(bank, y, current), idx, axis=0
+        )
+        # activations hop to the next stage
+        state = lax.ppermute(
+            y, axis_name, [(i, (i + 1) % n) for i in range(n)]
+        )
+        return (state, outputs), None
+
+    state0 = jnp.zeros(mb_shape, microbatches.dtype)
+    outputs0 = jnp.zeros((n_micro, *mb_shape), microbatches.dtype)
+    (_, outputs), _ = lax.scan(
+        tick, (state0, outputs0), jnp.arange(ticks)
+    )
+    # broadcast the last stage's outputs to every rank
+    is_last = (s == n - 1).astype(outputs.dtype)
+    return lax.psum(outputs * is_last, axis_name)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,   # leaves with leading dim = n_stages
+    x: jax.Array,          # (batch, ...) global input
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    axis_name: str = Axis.PIPE,
+    batch_axes: tuple[str, ...] = (Axis.DATA, Axis.FSDP),
+) -> jax.Array:
+    """Global wrapper: shard stacked stage params over ``axis_name``, split
+    the batch into microbatches, run the SPMD pipeline."""
+    n_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} not divisible into {n_microbatches} microbatches")
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stacked param leading dim {leaf.shape[0]} != pipe axis {n_stages}"
+            )
+    mb = batch // n_microbatches
+    xm = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stacked_params
+    )
+    x_spec = P(None, batch_axes)  # microbatch dim replicated, batch sharded
+
+    def local(params_stage, xm_local):
+        # params arrive with a leading stage dim of 1 on each shard
+        squeezed = jax.tree_util.tree_map(lambda p: p[0], params_stage)
+        return spmd_pipeline_local(
+            stage_fn, squeezed, xm_local, axis_name=axis_name
+        )
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    stacked_params = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, spec)
+        ) if isinstance(leaf, jax.Array) else leaf,
+        stacked_params, param_specs,
+    )
+    out = fn(stacked_params, xm)
+    return out.reshape(batch, *out.shape[2:])
