@@ -1,0 +1,350 @@
+"""Flagship Transformer (decoder LM / bidirectional encoder), parallel-native.
+
+The model family behind BASELINE configs 3 and 5 (BERT-base allreduce
+training; transformer serving) and the long-context story (SURVEY.md §5.7).
+Design choices are TPU-first:
+
+- bf16 compute / f32 params+softmax; matmul shapes padded to MXU-friendly
+  multiples by configuration, not runtime checks;
+- attention strategy per config: ``reference`` (XLA oracle), ``flash``
+  (Pallas kernel), ``ring`` (context parallel over ``seq``), ``ulysses``
+  (all_to_all SP) — the last two run in shard_map over the live mesh;
+- activations carry sharding constraints (batch over data/fsdp, seq over
+  seq) so pjit propagates layouts instead of guessing;
+- optional MoE FFN every Nth layer (expert axis, ``parallel.expert``);
+- param names line up with ``parallel.sharding.transformer_rules`` so
+  FSDP/TP layouts are one function call.
+
+Reference analog (UNVERIFIED upstream layout, SURVEY.md §0): the models live
+in user containers (HF ``transformers`` BERT for KServe's huggingfaceserver,
+Megatron-style layouts via MPIJob) — the platform never owned them; here the
+model zoo is first-party so every parallel strategy is testable end-to-end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.core.mesh import Axis
+from kubeflow_tpu.ops.flash_attention import flash_attention, reference_attention
+from kubeflow_tpu.parallel.expert import MoEConfig, moe_ffn
+from kubeflow_tpu.parallel.ring_attention import ring_attention_local
+from kubeflow_tpu.parallel.ulysses import ulysses_attention_local
+
+ATTN_IMPLS = ("reference", "flash", "ring", "ulysses")
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 512
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 1024
+    max_seq_len: int = 2048
+    causal: bool = True              # False → bidirectional encoder (BERT)
+    use_rope: bool = True            # False → learned positions (BERT)
+    dtype: Any = jnp.float32         # activation/compute dtype (bf16 on TPU)
+    attn_impl: str = "flash"
+    attn_block_q: int = 128
+    attn_block_k: int = 128
+    interpret_kernels: bool = False  # Pallas interpret mode (CPU tests)
+    remat: bool = False
+    moe_every: int = 0               # every Nth layer uses MoE FFN (0 = never)
+    moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    dropout_rate: float = 0.0
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    def validate(self) -> None:
+        if self.attn_impl not in ATTN_IMPLS:
+            raise ValueError(
+                f"attn_impl {self.attn_impl!r} not in {ATTN_IMPLS}"
+            )
+        if self.attn_impl == "ring" and not self.use_rope and self.causal:
+            pass  # fine; just unusual
+
+
+# --------------------------------------------------------------------------- #
+# building blocks
+# --------------------------------------------------------------------------- #
+
+def _act_constraint(x: jax.Array, *, seq_dim: int = 1) -> jax.Array:
+    """(batch, seq, d) activations: batch over data+fsdp, seq over seq."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty or Axis.DATA not in mesh.axis_names:
+        return x
+    spec = [None] * x.ndim
+    spec[0] = (Axis.DATA, Axis.FSDP)
+    spec[seq_dim] = Axis.SEQ
+    return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+def rope(x: jax.Array, positions: jax.Array, *, base: float = 10_000.0) -> jax.Array:
+    """Rotary embeddings; x: (B, H, S, D), positions: (B, S)."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None, :, None].astype(jnp.float32) * freq  # (B,1,S,half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + self.eps)
+        return (y * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        H, D = cfg.n_heads, cfg.head_dim
+        dense = lambda name: nn.Dense(
+            H * D, use_bias=False, dtype=cfg.dtype, name=name
+        )
+        q = dense("q_proj")(x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        k = dense("k_proj")(x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        v = dense("v_proj")(x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        if cfg.use_rope:
+            q, k = rope(q, positions), rope(k, positions)
+
+        o = dispatch_attention(q, k, v, cfg, segment_ids=segment_ids)
+
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+        return nn.Dense(
+            cfg.d_model, use_bias=False, dtype=cfg.dtype, name="o_proj"
+        )(o)
+
+
+def dispatch_attention(q, k, v, cfg: TransformerConfig, *, segment_ids=None):
+    """Route to the configured attention strategy. q/k/v: (B, H, S, D)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    kw = dict(
+        causal=cfg.causal,
+        block_q=cfg.attn_block_q,
+        block_k=cfg.attn_block_k,
+        interpret=cfg.interpret_kernels,
+    )
+    if cfg.attn_impl == "reference" or (
+        cfg.attn_impl == "flash" and mesh.empty
+    ):
+        if cfg.attn_impl == "reference":
+            return reference_attention(
+                q, k, v, causal=cfg.causal,
+                q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
+            )
+        return flash_attention(
+            q, k, v, q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
+            **kw,
+        )
+    if mesh.empty:
+        raise ValueError(
+            f"attn_impl {cfg.attn_impl!r} needs a mesh context (jax.set_mesh)"
+        )
+
+    spec = P((Axis.DATA, Axis.FSDP), Axis.MODEL, Axis.SEQ, None)
+    seg_spec = P((Axis.DATA, Axis.FSDP), Axis.SEQ)
+
+    if cfg.attn_impl == "flash":
+        def local(q, k, v, seg):
+            return flash_attention(
+                q, k, v,
+                q_segment_ids=seg, kv_segment_ids=seg, **kw,
+            )
+    elif cfg.attn_impl == "ring":
+        if segment_ids is not None:
+            raise NotImplementedError("ring attention with segment ids")
+        def local(q, k, v, seg):
+            del seg
+            return ring_attention_local(
+                q, k, v, axis_name=Axis.SEQ, causal=cfg.causal,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+                interpret=cfg.interpret_kernels,
+            )
+    else:  # ulysses
+        if segment_ids is not None:
+            raise NotImplementedError("ulysses attention with segment ids")
+        def local(q, k, v, seg):
+            del seg
+            return ulysses_attention_local(
+                q, k, v, axis_name=Axis.SEQ, causal=cfg.causal,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+                interpret=cfg.interpret_kernels,
+            )
+
+    if segment_ids is None:
+        segment_ids = jnp.zeros(q.shape[:1] + q.shape[2:3], jnp.int32)
+    if cfg.attn_impl == "flash" and mesh.shape.get(Axis.SEQ, 1) > 1:
+        raise ValueError(
+            "attn_impl='flash' cannot shard the seq axis; use 'ring' or "
+            "'ulysses' for sequence parallelism"
+        )
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v, segment_ids)
+
+
+class Mlp(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        up = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, name="up_proj")(x)
+        gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, name="gate_proj")(x)
+        return nn.Dense(
+            cfg.d_model, use_bias=False, dtype=cfg.dtype, name="down_proj"
+        )(nn.silu(gate) * up)
+
+
+class Experts(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x2d):
+        cfg, moe = self.cfg, self.cfg.moe
+        router = self.param(
+            "router_kernel",
+            nn.initializers.lecun_normal(),
+            (cfg.d_model, moe.num_experts),
+        )
+        up = self.param(
+            "up_kernel",
+            nn.initializers.lecun_normal(),
+            (moe.num_experts, cfg.d_model, moe.expert_dim),
+        )
+        down = self.param(
+            "down_kernel",
+            nn.initializers.lecun_normal(),
+            (moe.num_experts, moe.expert_dim, cfg.d_model),
+        )
+        out, aux, stats = moe_ffn(x2d, router, up, down, moe)
+        self.sow("losses", "moe_aux", aux)
+        return out
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        h = Attention(cfg, name="attn")(
+            RMSNorm(name="ln1")(x), positions, segment_ids
+        )
+        x = _act_constraint(x + h)
+        y = RMSNorm(name="ln2")(x)
+        if self.use_moe:
+            B, S, d = y.shape
+            out = Experts(cfg, name="experts")(y.reshape(B * S, d))
+            y = out.reshape(B, S, d)
+        else:
+            y = Mlp(cfg, name="mlp")(y)
+        return _act_constraint(x + y)
+
+
+class TransformerLM(nn.Module):
+    """Decoder LM (causal=True) or encoder (causal=False)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, segment_ids=None, positions=None):
+        cfg = self.cfg
+        cfg.validate()
+        B, S = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            dtype=cfg.dtype, name="embed",
+        )(tokens)
+        if not cfg.use_rope:
+            pos_emb = self.param(
+                "pos_embedding",
+                nn.initializers.normal(0.02),
+                (cfg.max_seq_len, cfg.d_model),
+            )
+            x = x + pos_emb[None, :S].astype(cfg.dtype)
+        x = _act_constraint(x)
+
+        BlockCls = nn.remat(Block) if cfg.remat else Block
+        for i in range(cfg.n_layers):
+            use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+            x = BlockCls(cfg, use_moe=use_moe, name=f"layers_{i}")(
+                x, positions, segment_ids
+            )
+        x = RMSNorm(name="ln_f")(x)
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="unembed"
+        )(x)
+
+
+# --------------------------------------------------------------------------- #
+# Trainer plumbing
+# --------------------------------------------------------------------------- #
+
+def make_init_fn(model: TransformerLM, seq_len: int, batch_size: int = 1):
+    """``batch_size`` must be divisible by the mesh's batch partitions when
+    the model's attention runs in shard_map (pass
+    ``MeshSpec.batch_partitions``)."""
+
+    def init_params(rng):
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return model.init(rng, dummy)["params"]
+
+    return init_params
+
+
+def make_loss_fn(model: TransformerLM):
+    """(params, {"inputs","targets"}, rng) → (loss, metrics). Includes MoE
+    aux losses sown by Experts blocks."""
+    import optax
+
+    def loss_fn(params, batch, rng):
+        del rng
+        logits, vars_out = model.apply(
+            {"params": params}, batch["inputs"], mutable=["losses"]
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["targets"]
+        ).mean()
+        metrics = {"lm_loss": loss}
+        aux_tree = vars_out.get("losses", {})
+        aux = sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(aux_tree))
+        if aux_tree:
+            loss = loss + aux
+            metrics["moe_aux"] = aux
+        acc = (jnp.argmax(logits, -1) == batch["targets"]).mean()
+        metrics["accuracy"] = acc
+        return loss, metrics
+
+    return loss_fn

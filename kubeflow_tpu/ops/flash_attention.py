@@ -27,6 +27,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -183,7 +184,7 @@ def _flash_forward(
 # --------------------------------------------------------------------------- #
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
 )
 def _flash(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k_and_interp):
     block_k, interpret = block_k_and_interp
@@ -202,12 +203,11 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k_and_inter
         causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
-def _flash_bwd(q_seg, kv_seg, causal, scale, block_q, block_k_and_interp,
-               res, dout):
-    q, k, v, out, lse = res
+def _flash_bwd(causal, scale, block_q, block_k_and_interp, res, dout):
+    q, k, v, q_seg, kv_seg, out, lse = res
     qf, kf, vf, doutf = (x.astype(jnp.float32) for x in (q, k, v, dout))
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
     mask = _full_mask(q.shape, k.shape, q_seg, kv_seg, causal)
@@ -221,7 +221,9 @@ def _flash_bwd(q_seg, kv_seg, causal, scale, block_q, block_k_and_interp,
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf).astype(q.dtype)
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf).astype(k.dtype)
     dv = dv.astype(v.dtype)
-    return dq, dk, dv
+    # integer segment ids carry symbolic-zero (float0) cotangents
+    zseg = lambda s: None if s is None else np.zeros(s.shape, jax.dtypes.float0)
+    return dq, dk, dv, zseg(q_seg), zseg(kv_seg)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

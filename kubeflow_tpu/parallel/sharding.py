@@ -120,6 +120,6 @@ def transformer_rules(
         # MoE experts: (n_experts, in, out) — expert dim over expert axis
         (r"experts/(up|gate)_kernel$", P(Axis.EXPERT, f, m)),
         (r"experts/down_kernel$", P(Axis.EXPERT, m, f)),
-        (r"router/kernel$", P(f, None)),
+        (r"experts/router_kernel$", P(f, None)),
     ]
     return ShardingRules(tuple(rules))

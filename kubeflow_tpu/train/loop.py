@@ -103,7 +103,10 @@ class Trainer:
             out_shardings = jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.mesh, s), specs
             )
-        state = jax.jit(mk, out_shardings=out_shardings)(rng)
+        # set_mesh: models read the context mesh for activation sharding
+        # constraints and shard_map attention (ring/ulysses/flash).
+        with jax.set_mesh(self.mesh):
+            state = jax.jit(mk, out_shardings=out_shardings)(rng)
         self._state_sharding = jax.tree_util.tree_map(lambda x: x.sharding, state)
         return state
 
@@ -227,6 +230,23 @@ class Trainer:
         t_last = time.perf_counter()
         last_logged = start_step
         try:
+            with jax.set_mesh(self.mesh):
+                return self._fit_loop(
+                    state, step_fn, it, ckpt, writer, hooks, history,
+                    start_step, t_last, last_logged,
+                )
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+            if own_writer:
+                writer.close()
+
+    def _fit_loop(
+        self, state, step_fn, it, ckpt, writer, hooks, history,
+        start_step, t_last, last_logged,
+    ):
+        cfg = self.config
+        try:
             for step in range(start_step, cfg.steps):
                 state, metrics = step_fn(state, self.global_batch_array(next(it)))
                 if ckpt is not None:
@@ -244,9 +264,6 @@ class Trainer:
         finally:
             if ckpt is not None:
                 self._final_save(ckpt, state)
-                ckpt.close()
-            if own_writer:
-                writer.close()
         return state, history
 
     @staticmethod
