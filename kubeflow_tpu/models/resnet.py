@@ -1,0 +1,127 @@
+"""ResNet-50 (v1.5) — BASELINE config 2 (TFJob ResNet-50 CIFAR-10 analog).
+
+TPU-first choices: NHWC layout (XLA:TPU's native conv layout), GroupNorm
+instead of BatchNorm — stateless, so the SPMD train step needs no
+cross-replica stat sync and no mutable collections (the
+MultiWorkerMirrored BN-sync machinery of the reference config dissolves);
+channel counts are MXU-tile multiples.
+
+Reference analog (UNVERIFIED upstream layout, SURVEY.md §0):
+[training-operator] examples/tensorflow/distribution_strategy — the model
+lived in the user container; first-party here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    num_filters: int = 64
+    num_classes: int = 10
+    cifar_stem: bool = True   # 3x3/1 stem for 32x32 inputs (vs 7x7/2)
+    groups: int = 32          # GroupNorm groups
+    dtype: Any = jnp.float32
+
+
+def resnet50_cifar(**kw) -> ResNetConfig:
+    return ResNetConfig(**kw)
+
+
+def resnet18_cifar(**kw) -> ResNetConfig:
+    base = dict(stage_sizes=(2, 2, 2, 2))
+    base.update(kw)
+    return ResNetConfig(**base)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        norm = lambda name: nn.GroupNorm(
+            num_groups=min(cfg.groups, self.filters), name=name
+        )
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=cfg.dtype, name="conv1")(x)
+        y = nn.relu(norm("gn1")(y))
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                    use_bias=False, dtype=cfg.dtype, name="conv2")(y)
+        y = nn.relu(norm("gn2")(y))
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                    dtype=cfg.dtype, name="conv3")(y)
+        y = nn.GroupNorm(
+            num_groups=min(cfg.groups, self.filters * 4), name="gn3"
+        )(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1), strides=(self.strides,) * 2,
+                use_bias=False, dtype=cfg.dtype, name="proj",
+            )(residual)
+            residual = nn.GroupNorm(
+                num_groups=min(cfg.groups, self.filters * 4), name="gn_proj"
+            )(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        if cfg.cifar_stem:
+            x = nn.Conv(cfg.num_filters, (3, 3), use_bias=False,
+                        dtype=cfg.dtype, name="stem")(x)
+        else:
+            x = nn.Conv(cfg.num_filters, (7, 7), strides=(2, 2),
+                        use_bias=False, dtype=cfg.dtype, name="stem")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(nn.GroupNorm(num_groups=cfg.groups, name="gn_stem")(x))
+
+        for stage, num_blocks in enumerate(cfg.stage_sizes):
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    filters=cfg.num_filters * 2**stage,
+                    strides=strides,
+                    cfg=cfg,
+                    name=f"stage{stage}_block{block}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def make_loss_fn(model: ResNet):
+    def loss_fn(params, batch, rng):
+        del rng
+        images, labels = batch
+        logits = model.apply({"params": params}, images)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, {"accuracy": acc}
+
+    return loss_fn
+
+
+def make_init_fn(model: ResNet, image_shape=(32, 32, 3)):
+    def init_params(rng):
+        return model.init(rng, jnp.zeros((1, *image_shape), jnp.float32))[
+            "params"
+        ]
+
+    return init_params

@@ -95,17 +95,17 @@ class Trainer:
                 rng=rng,
             )
 
-        if self.param_spec_fn is None:
-            out_shardings = self.repl
-        else:
-            abstract = jax.eval_shape(mk, rng)
-            specs = self._specs_for(abstract)
-            out_shardings = jax.tree_util.tree_map(
-                lambda s: NamedSharding(self.mesh, s), specs
-            )
         # set_mesh: models read the context mesh for activation sharding
         # constraints and shard_map attention (ring/ulysses/flash).
         with jax.set_mesh(self.mesh):
+            if self.param_spec_fn is None:
+                out_shardings = self.repl
+            else:
+                abstract = jax.eval_shape(mk, rng)
+                specs = self._specs_for(abstract)
+                out_shardings = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self.mesh, s), specs
+                )
             state = jax.jit(mk, out_shardings=out_shardings)(rng)
         self._state_sharding = jax.tree_util.tree_map(lambda x: x.sharding, state)
         return state
