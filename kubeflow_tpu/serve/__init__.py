@@ -1,0 +1,38 @@
+"""Serving plane: the KServe-equivalent, TPU-first (SURVEY.md §2.2, §7 step 5).
+
+Layout mirrors the reference's separation of concerns:
+
+- ``model``      — ``Model`` lifecycle (load/preprocess/predict/postprocess)
+                   + ``JAXModel`` with HBM-resident sharded weights and a
+                   bucket-batched jitted forward (no ragged-shape recompiles).
+- ``protocol``   — v1 (``:predict``) and v2 / Open Inference codecs.
+- ``server``     — aiohttp ``ModelServer`` + ``DataPlane`` registry.
+- ``batcher``    — request batching (max batch size / max latency).
+- ``logger``     — CloudEvents-style request/response logging.
+- ``storage``    — storage-initializer (``file://``, ``gs://`` stub) → local dir.
+- ``spec``       — ``InferenceService`` / ``ServingRuntime`` declarative specs.
+- ``controller`` — InferenceService reconciler: replicas, autoscaling,
+                   scale-to-zero, canary traffic split.
+- ``graph``      — ``InferenceGraph`` sequence/switch/ensemble/splitter routing.
+"""
+
+from kubeflow_tpu.serve.model import Model, JAXModel, BucketSpec
+from kubeflow_tpu.serve.server import ModelServer, DataPlane
+from kubeflow_tpu.serve.spec import (
+    InferenceServiceSpec,
+    PredictorSpec,
+    ServingRuntime,
+)
+from kubeflow_tpu.serve.controller import InferenceServiceController
+
+__all__ = [
+    "Model",
+    "JAXModel",
+    "BucketSpec",
+    "ModelServer",
+    "DataPlane",
+    "InferenceServiceSpec",
+    "PredictorSpec",
+    "ServingRuntime",
+    "InferenceServiceController",
+]

@@ -1,0 +1,211 @@
+"""InferenceService controller: reconcile ISVC specs into serving replicas.
+
+Reference analog: [kserve] pkg/controller/v1beta1/inferenceservice/
+{controller.go, reconcilers/{knative,raw,hpa}/} (UNVERIFIED, mount empty,
+SURVEY.md §0). The reference reconciles each component into either a Knative
+Service (serverless, scale-to-zero) or a raw Deployment+HPA. Without a
+cluster, a "replica" here is an in-process ``ModelServer`` dataplane entry
+plus an autoscaler state machine with the same observable semantics:
+
+- desired replicas ∈ [min, max], driven by in-flight concurrency vs
+  ``scale_target`` (the Knative/KPA-style signal);
+- minReplicas=0 ⇒ scale-to-zero after an idle window, cold-start on the
+  next request (the activator path) — cold-start latency is a BASELINE
+  config-5 adjacent metric;
+- canary: traffic split between ``default`` and ``canary`` model versions
+  by ``canary_traffic_percent``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any
+
+from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.serve.spec import (
+    InferenceServiceSpec,
+    RuntimeRegistry,
+)
+from kubeflow_tpu.serve import storage as storage_mod
+
+
+@dataclasses.dataclass
+class ReplicaSet:
+    """Autoscaler state for one ISVC component."""
+
+    ready_replicas: int = 0
+    desired_replicas: int = 0
+    in_flight: int = 0
+    last_request_ts: float = 0.0
+    cold_starts: int = 0
+
+
+def _mat_key(p) -> tuple:
+    """What determines the materialised model; a change ⇒ reload."""
+    return (p.model_format, p.storage_uri, p.runtime, dict(p.extra))
+
+
+@dataclasses.dataclass
+class ServiceState:
+    spec: InferenceServiceSpec
+    default_model: Model | None = None
+    canary_model: Model | None = None
+    default_key: tuple | None = None
+    canary_key: tuple | None = None
+    replicas: ReplicaSet = dataclasses.field(default_factory=ReplicaSet)
+    conditions: list[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ready(self) -> bool:
+        return self.default_model is not None and self.default_model.ready
+
+
+class InferenceServiceController:
+    def __init__(
+        self,
+        registry: RuntimeRegistry,
+        *,
+        model_dir: str = "/tmp/kubeflow_tpu_models",
+        idle_scale_to_zero_s: float = 30.0,
+        rng: random.Random | None = None,
+    ):
+        self.registry = registry
+        self.model_dir = model_dir
+        self.idle_scale_to_zero_s = idle_scale_to_zero_s
+        self._services: dict[str, ServiceState] = {}
+        self._rng = rng or random.Random(0)
+
+    # -- CRD-ish API --------------------------------------------------------
+
+    def apply(self, spec: InferenceServiceSpec) -> ServiceState:
+        spec.validate()
+        key = f"{spec.namespace}/{spec.name}"
+        prev = self._services.get(key)
+        st = ServiceState(spec=spec)
+        if prev is not None:
+            # rollout: previous default becomes the stable side of a canary
+            st.default_model = prev.default_model
+            st.default_key = prev.default_key
+            st.canary_model = prev.canary_model
+            st.canary_key = prev.canary_key
+            st.replicas = prev.replicas
+        self._services[key] = st
+        self.reconcile(key)
+        return st
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        st = self._services.pop(f"{namespace}/{name}", None)
+        if st:
+            for m in (st.default_model, st.canary_model):
+                if m is not None:
+                    m.unload()
+
+    def get(self, name: str, namespace: str = "default") -> ServiceState:
+        return self._services[f"{namespace}/{name}"]
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self, key: str) -> None:
+        st = self._services[key]
+        spec = st.spec
+        p = spec.predictor
+        canary_pct = p.canary_traffic_percent
+
+        new_key = _mat_key(p)
+        if st.default_model is None:
+            # first deploy: the new spec IS the default, whatever the pct
+            st.default_model = self._materialise(spec)
+            st.default_key = new_key
+            st.conditions.append("PredictorReady")
+        elif canary_pct == 100:
+            # plain rollout: a changed spec replaces the default outright
+            if st.default_key != new_key:
+                old = st.default_model
+                st.default_model = self._materialise(spec)
+                st.default_key = new_key
+                if old is not None:
+                    old.unload()
+                st.conditions.append("PredictorReady")
+            if st.canary_model is not None:
+                st.canary_model.unload()
+                st.canary_model, st.canary_key = None, None
+        else:
+            # canary rollout: new spec serves pct% alongside the old default
+            if st.canary_key != new_key:
+                old = st.canary_model
+                st.canary_model = self._materialise(spec)
+                st.canary_key = new_key
+                if old is not None:
+                    old.unload()
+                st.conditions.append("PredictorReady")
+
+        rs = st.replicas
+        rs.desired_replicas = max(p.min_replicas, min(1, p.max_replicas))
+        if rs.ready_replicas == 0 and rs.desired_replicas > 0:
+            rs.ready_replicas = rs.desired_replicas
+        st.conditions.append("Ready")
+
+    def _materialise(self, spec: InferenceServiceSpec) -> Model:
+        p = spec.predictor
+        rt = self.registry.resolve(p)
+        local_path = None
+        if p.storage_uri is not None:
+            local_path = storage_mod.download(
+                p.storage_uri, f"{self.model_dir}/{spec.name}"
+            )
+        model = rt.factory(spec.name, local_path, **dict(p.extra))
+        if not model.ready:
+            model.load()
+        return model
+
+    # -- traffic / autoscaling ---------------------------------------------
+
+    def route(self, name: str, namespace: str = "default") -> Model:
+        """Pick default vs canary per the traffic split; handles cold start."""
+        st = self.get(name, namespace)
+        rs = st.replicas
+        now = time.monotonic()
+        if rs.ready_replicas == 0:  # scaled to zero: activator cold start
+            rs.cold_starts += 1
+            rs.ready_replicas = 1
+            if st.default_model is not None and not st.default_model.ready:
+                st.default_model.load()
+        rs.last_request_ts = now
+        pct = st.spec.predictor.canary_traffic_percent
+        if st.canary_model is not None and self._rng.uniform(0, 100) < pct:
+            return st.canary_model
+        return st.default_model
+
+    def promote_canary(self, name: str, namespace: str = "default") -> None:
+        st = self.get(name, namespace)
+        if st.canary_model is None:
+            return
+        old = st.default_model
+        st.default_model, st.canary_model = st.canary_model, None
+        st.default_key, st.canary_key = st.canary_key, None
+        st.spec.predictor.canary_traffic_percent = 100
+        if old is not None:
+            old.unload()
+
+    def autoscale_tick(self, name: str, namespace: str = "default") -> int:
+        """One autoscaler evaluation; returns the new ready replica count."""
+        st = self.get(name, namespace)
+        p, rs = st.spec.predictor, st.replicas
+        if p.scale_target > 0 and rs.in_flight > 0:
+            want = -(-rs.in_flight // p.scale_target)  # ceil division
+        else:
+            want = 1 if rs.in_flight > 0 else rs.ready_replicas
+        idle = time.monotonic() - rs.last_request_ts
+        if (
+            p.min_replicas == 0
+            and rs.in_flight == 0
+            and idle > self.idle_scale_to_zero_s
+        ):
+            want = 0
+        rs.desired_replicas = max(p.min_replicas, min(want, p.max_replicas))
+        rs.ready_replicas = rs.desired_replicas
+        if rs.ready_replicas == 0 and st.default_model is not None:
+            st.default_model.unload()  # release HBM when scaled to zero
+        return rs.ready_replicas

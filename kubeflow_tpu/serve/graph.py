@@ -1,0 +1,94 @@
+"""InferenceGraph: sequence / switch / ensemble / splitter routing.
+
+Reference analog: [kserve] pkg/apis/serving/v1alpha1/inference_graph.go and
+cmd/router (UNVERIFIED, mount empty, SURVEY.md §0). Node types preserved:
+
+- ``Sequence``: steps run in order, each step's output feeds the next
+  (optionally gated by a condition on the previous output);
+- ``Switch``:   first step whose condition matches the input handles it;
+- ``Ensemble``: all steps run concurrently, outputs merged by step name;
+- ``Splitter``: weighted random routing across steps.
+
+A step targets either a model on a DataPlane or another graph node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+from typing import Any, Callable, Mapping
+
+from kubeflow_tpu.serve.server import DataPlane
+
+Condition = Callable[[Any], bool]
+
+
+@dataclasses.dataclass
+class Step:
+    name: str
+    model: str | None = None  # DataPlane model name
+    node: str | None = None  # or another graph node
+    weight: int = 1
+    condition: Condition | None = None
+
+
+@dataclasses.dataclass
+class Node:
+    kind: str  # Sequence | Switch | Ensemble | Splitter
+    steps: list[Step]
+
+
+class InferenceGraph:
+    def __init__(
+        self,
+        nodes: Mapping[str, Node],
+        dataplane: DataPlane,
+        *,
+        root: str = "root",
+        rng: random.Random | None = None,
+    ):
+        if root not in nodes:
+            raise ValueError(f"graph needs a '{root}' node")
+        self.nodes = dict(nodes)
+        self.dataplane = dataplane
+        self.root = root
+        self._rng = rng or random.Random(0)
+
+    async def infer(self, payload: Any) -> Any:
+        return await self._run_node(self.root, payload)
+
+    async def _run_step(self, step: Step, payload: Any) -> Any:
+        if step.model is not None:
+            return await self.dataplane.infer(step.model, payload)
+        return await self._run_node(step.node, payload)
+
+    async def _run_node(self, name: str, payload: Any) -> Any:
+        node = self.nodes[name]
+        if node.kind == "Sequence":
+            out = payload
+            for step in node.steps:
+                if step.condition is not None and not step.condition(out):
+                    continue
+                out = await self._run_step(step, out)
+            return out
+        if node.kind == "Switch":
+            for step in node.steps:
+                if step.condition is None or step.condition(payload):
+                    return await self._run_step(step, payload)
+            raise ValueError(f"switch node '{name}': no branch matched")
+        if node.kind == "Ensemble":
+            outs = await asyncio.gather(
+                *(self._run_step(s, payload) for s in node.steps)
+            )
+            return {s.name: o for s, o in zip(node.steps, outs)}
+        if node.kind == "Splitter":
+            total = sum(s.weight for s in node.steps)
+            pick = self._rng.uniform(0, total)
+            acc = 0.0
+            for step in node.steps:
+                acc += step.weight
+                if pick <= acc:
+                    return await self._run_step(step, payload)
+            return await self._run_step(node.steps[-1], payload)
+        raise ValueError(f"unknown node kind '{node.kind}'")

@@ -1,0 +1,55 @@
+"""Request/response logger: CloudEvents-style records to a sink.
+
+Reference analog: KServe's logger agent sidecar ([kserve] pkg/logger/ —
+UNVERIFIED, mount empty, SURVEY.md §0) emitting request/response CloudEvents
+to a configured sink URL. Here the sink is pluggable (in-memory list, JSONL
+file, or an async callable posting to a collector).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Callable
+
+
+class RequestLogger:
+    def __init__(self, sink: Callable[[dict], None] | str | None = None):
+        self.entries: list[dict] = []
+        self._file = None
+        if isinstance(sink, str):
+            self._file = open(sink, "a", buffering=1)
+            self._sink: Callable[[dict], None] = self._write_file
+        elif sink is not None:
+            self._sink = sink
+        else:
+            self._sink = self.entries.append
+
+    def _write_file(self, event: dict) -> None:
+        self._file.write(json.dumps(event) + "\n")
+
+    def _emit(self, event_type: str, model: str, req_id: str, payload: Any) -> None:
+        self._sink(
+            {
+                # CloudEvents v1.0 envelope attributes
+                "specversion": "1.0",
+                "id": str(uuid.uuid4()),
+                "source": f"kubeflow-tpu/serve/{model}",
+                "type": event_type,
+                "time": time.time(),
+                "inferenceserviceid": model,
+                "requestid": req_id,
+                "data": payload,
+            }
+        )
+
+    def log_request(self, model: str, req_id: str, payload: Any) -> None:
+        self._emit("org.kubeflow.serving.inference.request", model, req_id, payload)
+
+    def log_response(self, model: str, req_id: str, payload: Any) -> None:
+        self._emit("org.kubeflow.serving.inference.response", model, req_id, payload)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()

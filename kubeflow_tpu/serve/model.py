@@ -1,0 +1,199 @@
+"""Model lifecycle + TPU-resident jitted predictor.
+
+Reference analog: KServe's ``Model`` base class with its
+``load/preprocess/predict/postprocess`` lifecycle ([kserve]
+python/kserve/kserve/model.py — UNVERIFIED, mount empty, SURVEY.md §0).
+
+TPU-first differences (SURVEY.md §3.3 "TPU mapping"):
+
+- Weights are pushed to device HBM **once** at ``load()`` via
+  ``jax.device_put`` with an explicit sharding, and stay resident — the
+  reference reloads-to-GPU patterns don't apply; HBM residency is the whole
+  point of the TPUPredictor.
+- The forward is ``jax.jit``-ed per *bucket shape*, never per request:
+  ragged request batches are padded up to the nearest (batch, seq) bucket so
+  XLA compiles a small closed set of programs (SURVEY.md §7 hard-part 4).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import time
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Model:
+    """Base serving model: subclass and override the lifecycle hooks.
+
+    The DataPlane calls ``preprocess → predict → postprocess`` per request;
+    ``load()`` is called once before the model is marked ready.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ready = False
+
+    def load(self) -> bool:
+        self.ready = True
+        return self.ready
+
+    def preprocess(self, payload: Any, headers: Mapping[str, str] | None = None) -> Any:
+        return payload
+
+    def predict(self, inputs: Any, headers: Mapping[str, str] | None = None) -> Any:
+        raise NotImplementedError
+
+    def postprocess(self, outputs: Any, headers: Mapping[str, str] | None = None) -> Any:
+        return outputs
+
+    def unload(self) -> None:
+        self.ready = False
+
+    async def __call__(self, payload: Any, headers: Mapping[str, str] | None = None) -> Any:
+        x = self.preprocess(payload, headers)
+        y = self.predict(x, headers)
+        return self.postprocess(y, headers)
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """Closed set of padded shapes the jitted forward may see.
+
+    ``batch_sizes`` and ``seq_lens`` must be sorted ascending. A request of
+    shape (b, s) is padded up to the smallest bucket ≥ it; oversize requests
+    are split by the batcher upstream.
+    """
+
+    batch_sizes: tuple[int, ...] = (1, 4, 16)
+    seq_lens: tuple[int, ...] = (32, 128, 512)
+
+    def bucket_batch(self, n: int) -> int:
+        i = bisect.bisect_left(self.batch_sizes, n)
+        if i == len(self.batch_sizes):
+            raise ValueError(f"batch {n} exceeds max bucket {self.batch_sizes[-1]}")
+        return self.batch_sizes[i]
+
+    def bucket_seq(self, n: int) -> int:
+        i = bisect.bisect_left(self.seq_lens, n)
+        if i == len(self.seq_lens):
+            raise ValueError(f"seq {n} exceeds max bucket {self.seq_lens[-1]}")
+        return self.seq_lens[i]
+
+
+class JAXModel(Model):
+    """A jitted JAX predictor with HBM-resident params and bucket batching.
+
+    Parameters
+    ----------
+    apply_fn:
+        ``(params, input_ids, attention_mask) -> logits`` pure function.
+    init_params:
+        ``() -> params`` pytree factory, called at ``load()``.
+    sharding:
+        optional ``jax.sharding.Sharding`` for the params (replicated on a
+        single chip; NamedSharding over a mesh for multi-chip serving).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        apply_fn: Callable[..., jax.Array],
+        init_params: Callable[[], Any],
+        *,
+        buckets: BucketSpec | None = None,
+        sharding: jax.sharding.Sharding | None = None,
+        pad_id: int = 0,
+    ):
+        super().__init__(name)
+        self._apply_fn = apply_fn
+        self._init_params = init_params
+        self.buckets = buckets or BucketSpec()
+        self._sharding = sharding
+        self._pad_id = pad_id
+        self._params = None
+        self._jitted = None
+        self.stats: dict[str, Any] = {"requests": 0, "compiles": 0, "predict_ms": []}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def load(self) -> bool:
+        params = self._init_params()
+        if self._sharding is not None:
+            params = jax.device_put(params, self._sharding)
+        else:
+            params = jax.device_put(params)
+        # Block so readiness truthfully means "weights are in HBM".
+        jax.block_until_ready(params)
+        self._params = params
+
+        inner = self._apply_fn
+
+        def fwd(params, input_ids, attention_mask):
+            return inner(params, input_ids, attention_mask)
+
+        self._jitted = jax.jit(fwd)
+        self.ready = True
+        return True
+
+    def unload(self) -> None:
+        self._params = None
+        self._jitted = None
+        self.ready = False
+
+    def warmup(self) -> None:
+        """Pre-compile every bucket so first real requests don't pay XLA."""
+        for b in self.buckets.batch_sizes:
+            for s in self.buckets.seq_lens:
+                ids = np.zeros((b, s), np.int32)
+                mask = np.zeros((b, s), np.int32)
+                jax.block_until_ready(self._jitted(self._params, ids, mask))
+
+    # -- data path ----------------------------------------------------------
+
+    def preprocess(self, payload: Any, headers: Mapping[str, str] | None = None) -> Any:
+        """Accepts {"instances": [[ids...], ...]} (v1) or an int array."""
+        if isinstance(payload, Mapping) and "instances" in payload:
+            payload = payload["instances"]
+        rows = [np.asarray(r, np.int32) for r in payload]
+        if not rows:
+            raise ValueError("empty request")
+        return rows
+
+    def predict(self, inputs: Sequence[np.ndarray], headers=None) -> np.ndarray:
+        n = len(inputs)
+        s = max(int(r.shape[-1]) for r in inputs)
+        bb = self.buckets.bucket_batch(n)
+        bs = self.buckets.bucket_seq(s)
+        ids = np.full((bb, bs), self._pad_id, np.int32)
+        mask = np.zeros((bb, bs), np.int32)
+        for i, r in enumerate(inputs):
+            ids[i, : r.shape[-1]] = r
+            mask[i, : r.shape[-1]] = 1
+
+        before = self._compile_count()
+        t0 = time.perf_counter()
+        out = self._jitted(self._params, ids, mask)
+        out = np.asarray(jax.block_until_ready(out))
+        self.stats["predict_ms"].append((time.perf_counter() - t0) * 1e3)
+        self.stats["requests"] += 1
+        self.stats["compiles"] += self._compile_count() - before
+        return out[:n]  # strip batch padding; seq padding is caller-visible
+
+    def _compile_count(self) -> int:
+        cs = self._jitted._cache_size() if hasattr(self._jitted, "_cache_size") else 0
+        return int(cs)
+
+    def postprocess(self, outputs: np.ndarray, headers=None) -> Any:
+        return {"predictions": outputs.tolist()}
+
+
+class EchoModel(Model):
+    """Trivial model for protocol/controller tests (reference's dummy models)."""
+
+    def predict(self, inputs: Any, headers=None) -> Any:
+        return inputs

@@ -1,0 +1,99 @@
+"""Inference protocol codecs: v1 and v2 (Open Inference Protocol).
+
+Reference analog: [kserve] python/kserve/kserve/protocol/rest/
+{v1_endpoints,v2_endpoints}.py and infer_type.py tensor codecs (UNVERIFIED,
+mount empty, SURVEY.md §0). The wire formats are public specs:
+
+- v1:  ``POST /v1/models/<m>:predict``  body ``{"instances": [...]}``
+       → ``{"predictions": [...]}``
+- v2:  ``POST /v2/models/<m>/infer``    body ``{"inputs": [{name, shape,
+       datatype, data}]}`` → ``{"outputs": [...]}``.
+
+Codecs are pure (dict ↔ numpy); the aiohttp layer stays thin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+# Open Inference Protocol datatype ↔ numpy. BF16 is wire-encoded as uint16
+# words (no native JSON bf16); TPU-side code reinterprets.
+_V2_TO_NP = {
+    "BOOL": np.bool_,
+    "UINT8": np.uint8,
+    "UINT16": np.uint16,
+    "UINT32": np.uint32,
+    "UINT64": np.uint64,
+    "INT8": np.int8,
+    "INT16": np.int16,
+    "INT32": np.int32,
+    "INT64": np.int64,
+    "FP16": np.float16,
+    "FP32": np.float32,
+    "FP64": np.float64,
+    "BYTES": np.object_,
+}
+_NP_TO_V2 = {np.dtype(v).name: k for k, v in _V2_TO_NP.items() if k != "BYTES"}
+_NP_TO_V2["bfloat16"] = "BF16"
+
+
+@dataclasses.dataclass
+class InferTensor:
+    """One named tensor in a v2 request/response."""
+
+    name: str
+    data: np.ndarray
+
+    @classmethod
+    def from_v2(cls, obj: Mapping[str, Any]) -> "InferTensor":
+        dt = obj["datatype"].upper()
+        if dt == "BF16":
+            arr = np.asarray(obj["data"], np.uint16).reshape(obj["shape"])
+        else:
+            arr = np.asarray(obj["data"], _V2_TO_NP[dt]).reshape(obj["shape"])
+        return cls(name=obj["name"], data=arr)
+
+    def to_v2(self) -> dict[str, Any]:
+        arr = np.asarray(self.data)
+        dt = _NP_TO_V2.get(arr.dtype.name, "FP32")
+        return {
+            "name": self.name,
+            "shape": list(arr.shape),
+            "datatype": dt,
+            "data": arr.reshape(-1).tolist(),
+        }
+
+
+def decode_v1(body: Mapping[str, Any]) -> list[Any]:
+    if "instances" not in body:
+        raise ValueError("v1 request must contain 'instances'")
+    return list(body["instances"])
+
+
+def encode_v1(predictions: Any) -> dict[str, Any]:
+    if isinstance(predictions, Mapping) and "predictions" in predictions:
+        return dict(predictions)
+    if isinstance(predictions, np.ndarray):
+        predictions = predictions.tolist()
+    return {"predictions": predictions}
+
+
+def decode_v2(body: Mapping[str, Any]) -> dict[str, np.ndarray]:
+    if "inputs" not in body:
+        raise ValueError("v2 request must contain 'inputs'")
+    return {t["name"]: InferTensor.from_v2(t).data for t in body["inputs"]}
+
+
+def encode_v2(
+    model_name: str, outputs: Mapping[str, Any] | Sequence[InferTensor] | np.ndarray
+) -> dict[str, Any]:
+    if isinstance(outputs, np.ndarray):
+        tensors = [InferTensor("output_0", outputs)]
+    elif isinstance(outputs, Mapping):
+        tensors = [InferTensor(k, np.asarray(v)) for k, v in outputs.items()]
+    else:
+        tensors = list(outputs)
+    return {"model_name": model_name, "outputs": [t.to_v2() for t in tensors]}

@@ -1,0 +1,217 @@
+"""ModelServer: aiohttp REST server speaking v1 + v2 inference protocols.
+
+Reference analog: KServe's ``ModelServer`` (FastAPI/uvicorn + gRPC) and its
+``DataPlane`` registry ([kserve] python/kserve/kserve/model_server.py,
+protocol/dataplane.py — UNVERIFIED, mount empty, SURVEY.md §0). FastAPI is
+not in this image; aiohttp is (SURVEY.md §0), and an async single-process
+server is the right shape anyway — the chip serialises predict calls, so the
+win is async request admission + batching, not thread pools.
+
+Endpoints (wire-compatible with the reference so clients port unchanged):
+
+- ``GET  /``                                 liveness
+- ``GET  /v1/models``                        list models
+- ``GET  /v1/models/<m>``                    readiness of one model
+- ``POST /v1/models/<m>:predict``            v1 predict
+- ``GET  /v2/health/live`` ``/v2/health/ready``
+- ``GET  /v2/models/<m>``                    v2 metadata
+- ``POST /v2/models/<m>/infer``              v2 infer
+- ``GET  /metrics``                          Prometheus text format
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from collections import deque
+from typing import Any
+
+from aiohttp import web
+
+from kubeflow_tpu.serve import protocol
+from kubeflow_tpu.serve.batcher import Batcher, BatcherConfig
+from kubeflow_tpu.serve.logger import RequestLogger
+from kubeflow_tpu.serve.model import Model
+
+
+class DataPlane:
+    """Model registry + request execution (the per-request hot path)."""
+
+    def __init__(self, logger: RequestLogger | None = None):
+        self._models: dict[str, Model] = {}
+        self._batchers: dict[str, Batcher] = {}
+        self.logger = logger
+        self.metrics: dict[str, Any] = {"requests_total": {}, "latency_ms": {}}
+
+    # -- registry -----------------------------------------------------------
+
+    def register(self, model: Model, batcher: BatcherConfig | None = None) -> None:
+        self._models[model.name] = model
+        if batcher is not None:
+            self._batchers[model.name] = Batcher(
+                handler=lambda flat, m=model: self._predict_flat(m, flat),
+                config=batcher,
+            )
+
+    def unregister(self, name: str) -> None:
+        m = self._models.pop(name, None)
+        if m is not None:
+            m.unload()
+        self._batchers.pop(name, None)
+
+    def get(self, name: str) -> Model:
+        if name not in self._models:
+            raise web.HTTPNotFound(reason=f"model '{name}' not found")
+        return self._models[name]
+
+    def list_models(self) -> list[str]:
+        return sorted(self._models)
+
+    # -- execution ----------------------------------------------------------
+
+    async def _predict_flat(self, model: Model, flat: list[Any]) -> list[Any]:
+        x = model.preprocess({"instances": flat})
+        y = model.predict(x)
+        out = model.postprocess(y)
+        if isinstance(out, dict) and "predictions" in out:
+            return list(out["predictions"])
+        return list(out)
+
+    async def infer(self, name: str, payload: Any, headers=None) -> Any:
+        model = self.get(name)
+        if not model.ready:
+            raise web.HTTPServiceUnavailable(reason=f"model '{name}' not ready")
+        req_id = (headers or {}).get("x-request-id", str(uuid.uuid4()))
+        if self.logger is not None:
+            self.logger.log_request(name, req_id, payload)
+        t0 = time.perf_counter()
+        batcher = self._batchers.get(name)
+        if batcher is not None and isinstance(payload, dict) and "instances" in payload:
+            preds = await batcher.submit(list(payload["instances"]))
+            result: Any = {"predictions": preds}
+        else:
+            result = await model(payload, headers)
+        dt = (time.perf_counter() - t0) * 1e3
+        self.metrics["requests_total"][name] = self.metrics["requests_total"].get(name, 0) + 1
+        # bounded reservoir: long-lived servers must not accumulate a sample
+        # per request forever
+        self.metrics["latency_ms"].setdefault(name, deque(maxlen=4096)).append(dt)
+        if self.logger is not None:
+            self.logger.log_response(name, req_id, result)
+        return result
+
+
+class ModelServer:
+    def __init__(
+        self,
+        models: list[Model] | None = None,
+        *,
+        http_port: int = 8080,
+        logger: RequestLogger | None = None,
+        batcher: BatcherConfig | None = None,
+    ):
+        self.http_port = http_port
+        self.dataplane = DataPlane(logger=logger)
+        self._batcher_cfg = batcher
+        for m in models or []:
+            self.register(m)
+        self._runner: web.AppRunner | None = None
+
+    def register(self, model: Model) -> None:
+        if not model.ready:
+            model.load()
+        self.dataplane.register(model, self._batcher_cfg)
+
+    # -- app ----------------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 2**20)
+        dp = self.dataplane
+        app.router.add_get("/", lambda r: web.json_response({"status": "alive"}))
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get(
+            "/v1/models", lambda r: web.json_response({"models": dp.list_models()})
+        )
+        app.router.add_get("/v1/models/{name}", self._v1_status)
+        app.router.add_post("/v1/models/{name}:predict", self._v1_predict)
+        app.router.add_get(
+            "/v2/health/live", lambda r: web.json_response({"live": True})
+        )
+        app.router.add_get("/v2/health/ready", self._v2_ready)
+        app.router.add_get("/v2/models/{name}", self._v2_meta)
+        app.router.add_post("/v2/models/{name}/infer", self._v2_infer)
+        return app
+
+    async def _v1_status(self, req: web.Request) -> web.Response:
+        m = self.dataplane.get(req.match_info["name"])
+        return web.json_response({"name": m.name, "ready": m.ready})
+
+    async def _v1_predict(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        name = req.match_info["name"]
+        protocol.decode_v1(body)  # validate shape of the envelope
+        result = await self.dataplane.infer(name, body, dict(req.headers))
+        return web.json_response(protocol.encode_v1(result))
+
+    async def _v2_ready(self, req: web.Request) -> web.Response:
+        ready = all(self.dataplane.get(n).ready for n in self.dataplane.list_models())
+        return web.json_response({"ready": ready})
+
+    async def _v2_meta(self, req: web.Request) -> web.Response:
+        m = self.dataplane.get(req.match_info["name"])
+        return web.json_response(
+            {"name": m.name, "ready": m.ready, "platform": "jax-tpu"}
+        )
+
+    async def _v2_infer(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        name = req.match_info["name"]
+        tensors = protocol.decode_v2(body)
+        ids = tensors.get("input_ids")
+        payload = {"instances": ids.tolist()} if ids is not None else {
+            "instances": next(iter(tensors.values())).tolist()
+        }
+        result = await self.dataplane.infer(name, payload, dict(req.headers))
+        preds = result["predictions"] if isinstance(result, dict) else result
+        import numpy as np
+
+        return web.json_response(protocol.encode_v2(name, np.asarray(preds)))
+
+    async def _metrics(self, req: web.Request) -> web.Response:
+        lines = []
+        for name, n in self.dataplane.metrics["requests_total"].items():
+            lines.append(
+                f'kubeflow_tpu_requests_total{{model="{name}"}} {n}'
+            )
+        for name, lat in self.dataplane.metrics["latency_ms"].items():
+            if lat:
+                srt = sorted(lat)
+                p50 = srt[len(srt) // 2]
+                p99 = srt[min(len(srt) - 1, int(len(srt) * 0.99))]
+                lines.append(f'kubeflow_tpu_latency_p50_ms{{model="{name}"}} {p50:.3f}')
+                lines.append(f'kubeflow_tpu_latency_p99_ms{{model="{name}"}} {p99:.3f}')
+        return web.Response(text="\n".join(lines) + "\n")
+
+    # -- runtime ------------------------------------------------------------
+
+    async def start_async(self) -> None:
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "0.0.0.0", self.http_port)
+        await site.start()
+
+    async def stop_async(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    def start(self) -> None:
+        """Blocking entrypoint (the container CMD)."""
+
+        async def main():
+            await self.start_async()
+            while True:
+                await asyncio.sleep(3600)
+
+        asyncio.run(main())

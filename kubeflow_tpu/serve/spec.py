@@ -1,0 +1,103 @@
+"""InferenceService / ServingRuntime declarative specs.
+
+Reference analog: [kserve] pkg/apis/serving/v1beta1/{inference_service,
+predictor,component}.go and v1alpha1/servingruntime_types.go (UNVERIFIED,
+mount empty, SURVEY.md §0). Semantics preserved:
+
+- predictor / transformer / explainer component specs;
+- min/maxReplicas + scaleTarget (concurrency) autoscaling knobs,
+  minReplicas=0 ⇒ scale-to-zero;
+- canary traffic percent on the predictor;
+- ServingRuntime decouples model format → runtime implementation.
+
+TPU-first: a component carries a ``TPURequest``-style accelerator claim and
+a ``MeshSpec`` (multi-chip serving shards weights over the mesh), not a GPU
+count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+from kubeflow_tpu.core.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ServingRuntime:
+    """Maps a model format to a concrete Model factory.
+
+    The reference maps format → container image; with in-process serving the
+    analog is format → ``Model`` factory callable.
+    """
+
+    name: str
+    supported_formats: tuple[str, ...]
+    factory: Callable[..., Any]  # (name, storage_path, **kwargs) -> Model
+    priority: int = 0
+
+
+@dataclasses.dataclass
+class ComponentSpec:
+    """One ISVC component (predictor/transformer/explainer)."""
+
+    model_format: str | None = None
+    storage_uri: str | None = None
+    runtime: str | None = None  # explicit ServingRuntime name
+    min_replicas: int = 1  # 0 = scale-to-zero
+    max_replicas: int = 1
+    scale_target: int = 1  # target in-flight requests per replica
+    mesh: MeshSpec | None = None
+    extra: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PredictorSpec(ComponentSpec):
+    canary_traffic_percent: int = 100
+
+
+@dataclasses.dataclass
+class InferenceServiceSpec:
+    name: str
+    predictor: PredictorSpec
+    transformer: ComponentSpec | None = None
+    explainer: ComponentSpec | None = None
+    namespace: str = "default"
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("InferenceService needs a name")
+        p = self.predictor
+        if p.min_replicas < 0 or p.max_replicas < max(1, p.min_replicas):
+            raise ValueError(
+                f"bad replica bounds min={p.min_replicas} max={p.max_replicas}"
+            )
+        if not (0 <= p.canary_traffic_percent <= 100):
+            raise ValueError("canaryTrafficPercent must be 0..100")
+        if p.model_format is None and p.runtime is None:
+            raise ValueError("predictor needs model_format or explicit runtime")
+
+
+class RuntimeRegistry:
+    """ClusterServingRuntime lookup: format → highest-priority runtime."""
+
+    def __init__(self):
+        self._runtimes: dict[str, ServingRuntime] = {}
+
+    def register(self, rt: ServingRuntime) -> None:
+        self._runtimes[rt.name] = rt
+
+    def resolve(self, spec: ComponentSpec) -> ServingRuntime:
+        if spec.runtime is not None:
+            try:
+                return self._runtimes[spec.runtime]
+            except KeyError:
+                raise ValueError(f"unknown runtime '{spec.runtime}'") from None
+        candidates = [
+            rt
+            for rt in self._runtimes.values()
+            if spec.model_format in rt.supported_formats
+        ]
+        if not candidates:
+            raise ValueError(f"no runtime supports format '{spec.model_format}'")
+        return max(candidates, key=lambda rt: rt.priority)

@@ -1,0 +1,52 @@
+"""Storage initializer: fetch model artifacts to a local model dir.
+
+Reference analog: KServe's storage-initializer init container + Storage
+class ([kserve] python/kserve/kserve/storage/storage.py — UNVERIFIED, mount
+empty, SURVEY.md §0): downloads ``gs://``/``s3://``/``pvc://``/http URIs to
+``/mnt/models`` before the server starts.
+
+This env has zero egress (SURVEY.md §0), so remote schemes are represented
+by a registry of fetchers: ``file://`` and bare paths work out of the box;
+``gs://``/``s3://`` raise a clear error unless a fetcher is registered
+(tests register in-memory fakes; production registers real clients).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Callable
+
+# scheme -> fetcher(uri, dest_dir) -> local path
+_FETCHERS: dict[str, Callable[[str, str], str]] = {}
+
+
+def register_fetcher(scheme: str, fn: Callable[[str, str], str]) -> None:
+    _FETCHERS[scheme] = fn
+
+
+def download(storage_uri: str, dest_dir: str) -> str:
+    """Materialise ``storage_uri`` under ``dest_dir``; returns the local path."""
+    os.makedirs(dest_dir, exist_ok=True)
+    scheme, sep, rest = storage_uri.partition("://")
+    if not sep:
+        scheme, rest = "file", storage_uri
+    if scheme == "file":
+        src = rest if rest.startswith("/") else os.path.abspath(rest)
+        if not os.path.exists(src):
+            raise FileNotFoundError(src)
+        dest = os.path.join(dest_dir, os.path.basename(src.rstrip("/")))
+        if os.path.isdir(src):
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(src, dest)
+        else:
+            shutil.copy2(src, dest)
+        return dest
+    fetcher = _FETCHERS.get(scheme)
+    if fetcher is None:
+        raise RuntimeError(
+            f"no fetcher registered for scheme '{scheme}://' "
+            f"(register one with kubeflow_tpu.serve.storage.register_fetcher)"
+        )
+    return fetcher(storage_uri, dest_dir)

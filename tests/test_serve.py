@@ -1,0 +1,469 @@
+"""Serving plane tests (SURVEY.md §4: KServe pytest analog — protocol
+codecs, Model lifecycle with dummy models, batcher, controller semantics)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serve import protocol
+from kubeflow_tpu.serve.batcher import Batcher, BatcherConfig
+from kubeflow_tpu.serve.logger import RequestLogger
+from kubeflow_tpu.serve.model import BucketSpec, EchoModel, JAXModel, Model
+from kubeflow_tpu.serve.server import ModelServer
+from kubeflow_tpu.serve.spec import (
+    ComponentSpec,
+    InferenceServiceSpec,
+    PredictorSpec,
+    RuntimeRegistry,
+    ServingRuntime,
+)
+from kubeflow_tpu.serve.controller import InferenceServiceController
+from kubeflow_tpu.serve.graph import InferenceGraph, Node, Step
+from kubeflow_tpu.serve import storage as storage_mod
+
+
+# ---------------------------------------------------------------- protocol
+
+
+def test_v1_codec_roundtrip():
+    body = {"instances": [[1, 2], [3, 4]]}
+    assert protocol.decode_v1(body) == [[1, 2], [3, 4]]
+    out = protocol.encode_v1(np.array([[0.1, 0.9]]))
+    assert out == {"predictions": [[pytest.approx(0.1), pytest.approx(0.9)]]}
+    with pytest.raises(ValueError):
+        protocol.decode_v1({"inputs": []})
+
+
+def test_v2_codec_roundtrip():
+    body = {
+        "inputs": [
+            {"name": "input_ids", "shape": [2, 3], "datatype": "INT32",
+             "data": [1, 2, 3, 4, 5, 6]},
+            {"name": "scale", "shape": [1], "datatype": "FP32", "data": [0.5]},
+        ]
+    }
+    tensors = protocol.decode_v2(body)
+    assert tensors["input_ids"].shape == (2, 3)
+    assert tensors["input_ids"].dtype == np.int32
+    assert tensors["scale"].dtype == np.float32
+
+    enc = protocol.encode_v2("m", {"logits": np.ones((1, 2), np.float32)})
+    assert enc["outputs"][0]["datatype"] == "FP32"
+    assert enc["outputs"][0]["shape"] == [1, 2]
+
+    # bf16 rides the wire as uint16 words
+    t = protocol.InferTensor.from_v2(
+        {"name": "w", "shape": [2], "datatype": "BF16", "data": [16256, 0]}
+    )
+    assert t.data.dtype == np.uint16
+
+
+# ------------------------------------------------------------------ buckets
+
+
+def test_bucket_spec_rounds_up():
+    b = BucketSpec(batch_sizes=(1, 4, 8), seq_lens=(16, 64))
+    assert b.bucket_batch(1) == 1
+    assert b.bucket_batch(3) == 4
+    assert b.bucket_seq(17) == 64
+    with pytest.raises(ValueError):
+        b.bucket_batch(9)
+
+
+def test_jax_model_bucketing_prevents_recompiles(devices8):
+    """Ragged request shapes must hit a closed set of compiled programs."""
+    import jax.numpy as jnp
+
+    def apply_fn(params, ids, mask):
+        return (ids * params["w"] * mask).sum(-1)
+
+    m = JAXModel(
+        "toy",
+        apply_fn,
+        lambda: {"w": jnp.int32(2)},
+        buckets=BucketSpec(batch_sizes=(1, 4), seq_lens=(8, 16)),
+    )
+    m.load()
+    m.warmup()  # compiles all 4 buckets
+    compiles_after_warmup = m.stats["compiles"]
+    # Many ragged shapes, all inside existing buckets → zero new compiles.
+    for rows in ([[1, 2, 3]], [[1] * 5, [2] * 7], [[9] * 12], [[1], [2], [3]]):
+        out = m.predict(m.preprocess({"instances": rows}))
+        assert out.shape[0] == len(rows)
+    assert m.stats["compiles"] == compiles_after_warmup
+
+
+def test_jax_model_correct_padding_semantics(devices8):
+    def apply_fn(params, ids, mask):
+        return (ids * mask).sum(-1)  # padded slots masked out
+
+    m = JAXModel("sum", apply_fn, lambda: {},
+                 buckets=BucketSpec(batch_sizes=(4,), seq_lens=(8,)))
+    m.load()
+    out = m.predict(m.preprocess({"instances": [[1, 2, 3], [10]]}))
+    assert out.tolist() == [6, 10]  # batch padding stripped, seq padding masked
+
+
+# ------------------------------------------------------------------ batcher
+
+
+def test_batcher_flushes_on_size_and_latency():
+    calls = []
+
+    async def handler(flat):
+        calls.append(list(flat))
+        return [x * 10 for x in flat]
+
+    async def run():
+        b = Batcher(handler, BatcherConfig(max_batch_size=4, max_latency_ms=20))
+        # size-triggered flush: two submits totalling 4 instances
+        r1, r2 = await asyncio.gather(b.submit([1, 2]), b.submit([3, 4]))
+        assert r1 == [10, 20] and r2 == [30, 40]
+        assert len(calls) == 1 and sorted(calls[0]) == [1, 2, 3, 4]
+        # latency-triggered flush: single small submit
+        r3 = await b.submit([5])
+        assert r3 == [50]
+        assert len(calls) == 2
+        assert b.stats["batches"] == 2 and b.stats["instances"] == 5
+
+    asyncio.run(run())
+
+
+def test_batcher_deadline_flush_with_awaiting_handler():
+    """Regression: the timer task must not cancel itself mid-handler-await."""
+
+    async def handler(flat):
+        await asyncio.sleep(0.01)  # a real TPU forward awaits
+        return [x + 1 for x in flat]
+
+    async def run():
+        b = Batcher(handler, BatcherConfig(max_batch_size=64, max_latency_ms=5))
+        out = await asyncio.wait_for(b.submit([1, 2]), timeout=2.0)
+        assert out == [2, 3]
+
+    asyncio.run(run())
+
+
+def test_batcher_splits_oversize_submits():
+    calls = []
+
+    async def handler(flat):
+        calls.append(len(flat))
+        return [x * 2 for x in flat]
+
+    async def run():
+        b = Batcher(handler, BatcherConfig(max_batch_size=4, max_latency_ms=5))
+        out = await b.submit(list(range(10)))  # > max_batch_size
+        assert out == [x * 2 for x in range(10)]
+        assert calls == [4, 4, 2]  # chunked, never above the cap
+
+    asyncio.run(run())
+
+
+def test_batcher_accumulates_while_handler_runs():
+    """Requests arriving during an in-flight forward join the NEXT batch."""
+    calls = []
+    release = asyncio.Event()
+
+    async def handler(flat):
+        calls.append(sorted(flat))
+        if len(calls) == 1:
+            await release.wait()  # first batch in flight...
+        return flat
+
+    async def run():
+        b = Batcher(handler, BatcherConfig(max_batch_size=2, max_latency_ms=5))
+        t1 = asyncio.create_task(b.submit([1, 2]))  # size-flushes immediately
+        await asyncio.sleep(0.01)
+        t2 = asyncio.create_task(b.submit([3]))  # queued while #1 in flight
+        await asyncio.sleep(0.02)
+        release.set()
+        assert await asyncio.wait_for(asyncio.gather(t1, t2), 2.0) == [[1, 2], [3]]
+        assert calls == [[1, 2], [3]]
+
+    asyncio.run(run())
+
+
+def test_batcher_propagates_handler_errors():
+    async def handler(flat):
+        raise RuntimeError("boom")
+
+    async def run():
+        b = Batcher(handler, BatcherConfig(max_batch_size=1))
+        with pytest.raises(RuntimeError):
+            await b.submit([1])
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------- server
+
+
+class _Doubler(Model):
+    def predict(self, inputs, headers=None):
+        return {"predictions": [[2 * v for v in row] for row in inputs["instances"]]}
+
+
+def test_model_server_v1_v2_endpoints():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    logger = RequestLogger()
+    server = ModelServer([_Doubler("dbl")], logger=logger)
+
+    async def run():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.get("/")
+            assert (await r.json())["status"] == "alive"
+            r = await client.get("/v1/models")
+            assert (await r.json())["models"] == ["dbl"]
+            r = await client.get("/v1/models/dbl")
+            assert (await r.json())["ready"] is True
+
+            r = await client.post(
+                "/v1/models/dbl:predict", json={"instances": [[1, 2], [3, 4]]}
+            )
+            assert (await r.json())["predictions"] == [[2, 4], [6, 8]]
+
+            r = await client.post(
+                "/v2/models/dbl/infer",
+                json={"inputs": [{"name": "input_ids", "shape": [1, 2],
+                                  "datatype": "INT32", "data": [5, 6]}]},
+            )
+            body = await r.json()
+            assert body["outputs"][0]["data"] == [10, 12]
+
+            r = await client.get("/v2/health/ready")
+            assert (await r.json())["ready"] is True
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert 'kubeflow_tpu_requests_total{model="dbl"} 2' in text
+            assert "latency_p50_ms" in text
+
+            r = await client.post("/v1/models/nope:predict", json={"instances": []})
+            assert r.status == 404
+
+    asyncio.run(run())
+    # logger captured request+response CloudEvents for both inferences
+    kinds = [e["type"] for e in logger.entries]
+    assert kinds.count("org.kubeflow.serving.inference.request") == 2
+    assert kinds.count("org.kubeflow.serving.inference.response") == 2
+    assert all(e["specversion"] == "1.0" for e in logger.entries)
+
+
+def test_model_server_batching_path():
+    server = ModelServer([_Doubler("dbl")],
+                         batcher=BatcherConfig(max_batch_size=2, max_latency_ms=10))
+
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async with TestClient(TestServer(server.build_app())) as client:
+            r1, r2 = await asyncio.gather(
+                client.post("/v1/models/dbl:predict", json={"instances": [[1]]}),
+                client.post("/v1/models/dbl:predict", json={"instances": [[2]]}),
+            )
+            assert (await r1.json())["predictions"] == [[2]]
+            assert (await r2.json())["predictions"] == [[4]]
+
+    asyncio.run(run())
+    b = server.dataplane._batchers["dbl"]
+    assert b.stats["instances"] == 2
+
+
+# ------------------------------------------------------------------ storage
+
+
+def test_storage_file_and_stub_schemes(tmp_path):
+    src = tmp_path / "weights"
+    src.mkdir()
+    (src / "w.bin").write_bytes(b"abc")
+    dest = storage_mod.download(f"file://{src}", str(tmp_path / "mnt"))
+    import os
+
+    assert os.path.exists(os.path.join(dest, "w.bin"))
+
+    with pytest.raises(RuntimeError, match="no fetcher"):
+        storage_mod.download("gs://bucket/model", str(tmp_path / "mnt2"))
+
+    storage_mod.register_fetcher(
+        "gs", lambda uri, d: str((src / "w.bin"))
+    )
+    assert storage_mod.download("gs://bucket/model", str(tmp_path / "m3")).endswith(
+        "w.bin"
+    )
+    storage_mod._FETCHERS.pop("gs")
+
+
+# --------------------------------------------------------------- controller
+
+
+def _echo_registry():
+    reg = RuntimeRegistry()
+    reg.register(ServingRuntime("echo", ("echo",),
+                                lambda name, path, **kw: EchoModel(name)))
+    return reg
+
+
+def test_isvc_validate_and_runtime_resolution():
+    spec = InferenceServiceSpec("s", PredictorSpec(model_format="echo"))
+    spec.validate()
+    with pytest.raises(ValueError):
+        InferenceServiceSpec(
+            "s", PredictorSpec(model_format="echo", min_replicas=2, max_replicas=1)
+        ).validate()
+    reg = _echo_registry()
+    assert reg.resolve(ComponentSpec(model_format="echo")).name == "echo"
+    with pytest.raises(ValueError):
+        reg.resolve(ComponentSpec(model_format="onnx"))
+
+
+def test_isvc_controller_deploy_and_canary(tmp_path):
+    ctl = InferenceServiceController(_echo_registry(), model_dir=str(tmp_path))
+    st = ctl.apply(InferenceServiceSpec("svc", PredictorSpec(model_format="echo")))
+    assert st.ready and "PredictorReady" in st.conditions
+
+    # canary rollout at 30%: both models live, traffic split ~30/70
+    ctl.apply(
+        InferenceServiceSpec(
+            "svc", PredictorSpec(model_format="echo", canary_traffic_percent=30)
+        )
+    )
+    st = ctl.get("svc")
+    assert st.canary_model is not None and st.default_model is not None
+    picks = [ctl.route("svc") for _ in range(400)]
+    frac = sum(p is st.canary_model for p in picks) / len(picks)
+    assert 0.2 < frac < 0.4
+
+    ctl.promote_canary("svc")
+    st = ctl.get("svc")
+    assert st.canary_model is None
+    assert st.spec.predictor.canary_traffic_percent == 100
+
+
+def test_isvc_plain_rollout_reloads_model(tmp_path):
+    """Regression: re-apply at 100% with a changed spec must swap the model."""
+    loads = []
+    reg = RuntimeRegistry()
+
+    def factory(name, path, version=0):
+        loads.append(version)
+        return EchoModel(f"{name}-v{version}")
+
+    reg.register(ServingRuntime("echo", ("echo",), factory))
+    ctl = InferenceServiceController(reg, model_dir=str(tmp_path))
+
+    ctl.apply(InferenceServiceSpec(
+        "r", PredictorSpec(model_format="echo", extra={"version": 1})))
+    m1 = ctl.get("r").default_model
+    # identical re-apply: no reload
+    ctl.apply(InferenceServiceSpec(
+        "r", PredictorSpec(model_format="echo", extra={"version": 1})))
+    assert ctl.get("r").default_model is m1 and loads == [1]
+    # changed spec at default 100%: model swapped, old unloaded
+    ctl.apply(InferenceServiceSpec(
+        "r", PredictorSpec(model_format="echo", extra={"version": 2})))
+    st = ctl.get("r")
+    assert st.default_model is not m1 and not m1.ready
+    assert loads == [1, 2] and st.canary_model is None
+
+
+def test_isvc_scale_to_zero_and_cold_start(tmp_path, monkeypatch):
+    ctl = InferenceServiceController(
+        _echo_registry(), model_dir=str(tmp_path), idle_scale_to_zero_s=0.0
+    )
+    ctl.apply(
+        InferenceServiceSpec(
+            "z", PredictorSpec(model_format="echo", min_replicas=0, max_replicas=2)
+        )
+    )
+    st = ctl.get("z")
+    ctl.route("z")  # one request, then idle
+    assert ctl.autoscale_tick("z") == 0  # idle > 0s window → scaled to zero
+    assert not st.default_model.ready  # HBM released
+
+    m = ctl.route("z")  # next request cold-starts
+    assert m.ready and st.replicas.cold_starts == 1
+
+    # concurrency drives scale-up: 5 in-flight @ scale_target=1 → max_replicas
+    st.spec.predictor.scale_target = 1
+    st.replicas.in_flight = 5
+    assert ctl.autoscale_tick("z") == 2
+
+
+# -------------------------------------------------------------------- graph
+
+
+def test_inference_graph_nodes():
+    from kubeflow_tpu.serve.server import DataPlane
+
+    class Add(Model):
+        def __init__(self, name, k):
+            super().__init__(name)
+            self.k = k
+            self.ready = True
+
+        async def __call__(self, payload, headers=None):
+            return {"instances": [[v + self.k for v in row]
+                                  for row in payload["instances"]]}
+
+    dp = DataPlane()
+    dp.register(Add("a1", 1))
+    dp.register(Add("a10", 10))
+
+    graph = InferenceGraph(
+        {
+            "root": Node("Sequence", [Step("s1", model="a1"),
+                                      Step("s2", node="fanout")]),
+            "fanout": Node("Ensemble", [Step("e1", model="a1"),
+                                        Step("e10", model="a10")]),
+        },
+        dp,
+    )
+
+    async def run():
+        out = await graph.infer({"instances": [[0]]})
+        assert out["e1"]["instances"] == [[2]]
+        assert out["e10"]["instances"] == [[11]]
+
+        switch = InferenceGraph(
+            {"root": Node("Switch", [
+                Step("big", model="a10",
+                     condition=lambda p: p["instances"][0][0] > 5),
+                Step("small", model="a1"),
+            ])},
+            dp,
+        )
+        assert (await switch.infer({"instances": [[9]]}))["instances"] == [[19]]
+        assert (await switch.infer({"instances": [[1]]}))["instances"] == [[2]]
+
+        splitter = InferenceGraph(
+            {"root": Node("Splitter", [Step("w1", model="a1", weight=1),
+                                       Step("w9", model="a10", weight=9)])},
+            dp,
+        )
+        outs = [await splitter.infer({"instances": [[0]]}) for _ in range(200)]
+        frac10 = sum(o["instances"][0][0] == 10 for o in outs) / len(outs)
+        assert frac10 > 0.75
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------- bert runtime (e2e)
+
+
+def test_bert_runtime_text_to_tokens(devices8):
+    from kubeflow_tpu.models.bert import bert_tiny
+    from kubeflow_tpu.serve.model import BucketSpec
+    from kubeflow_tpu.serve.runtimes import BertRuntimeModel
+
+    m = BertRuntimeModel(
+        "bert", None, config=bert_tiny(attn_impl="reference"),
+        buckets=BucketSpec(batch_sizes=(1, 2), seq_lens=(16,)),
+    )
+    m.load()
+    out = m.postprocess(m.predict(m.preprocess(
+        {"instances": ["hello [MASK] world", "the cat sat"]})))
+    preds = out["predictions"]
+    assert len(preds) == 2 and len(preds[0]) == 16
+    assert all(isinstance(t, int) for t in preds[0])
