@@ -206,6 +206,8 @@ class InferenceServiceController:
             want = 0
         rs.desired_replicas = max(p.min_replicas, min(want, p.max_replicas))
         rs.ready_replicas = rs.desired_replicas
-        if rs.ready_replicas == 0 and st.default_model is not None:
-            st.default_model.unload()  # release HBM when scaled to zero
+        if rs.ready_replicas == 0:  # release HBM when scaled to zero
+            for m in (st.default_model, st.canary_model):
+                if m is not None:
+                    m.unload()
         return rs.ready_replicas

@@ -20,6 +20,7 @@ from __future__ import annotations
 import bisect
 import dataclasses
 import time
+from collections import deque
 from typing import Any, Callable, Mapping, Sequence
 
 import jax
@@ -117,7 +118,11 @@ class JAXModel(Model):
         self._pad_id = pad_id
         self._params = None
         self._jitted = None
-        self.stats: dict[str, Any] = {"requests": 0, "compiles": 0, "predict_ms": []}
+        self.stats: dict[str, Any] = {
+            "requests": 0,
+            "compiles": 0,
+            "predict_ms": deque(maxlen=1024),  # bounded: long-lived servers
+        }
 
     # -- lifecycle ----------------------------------------------------------
 

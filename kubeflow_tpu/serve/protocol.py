@@ -59,11 +59,15 @@ class InferTensor:
     def to_v2(self) -> dict[str, Any]:
         arr = np.asarray(self.data)
         dt = _NP_TO_V2.get(arr.dtype.name, "FP32")
+        if dt == "BF16":
+            data = arr.view(np.uint16).reshape(-1).tolist()  # wire = u16 words
+        else:
+            data = arr.reshape(-1).tolist()
         return {
             "name": self.name,
             "shape": list(arr.shape),
             "datatype": dt,
-            "data": arr.reshape(-1).tolist(),
+            "data": data,
         }
 
 

@@ -46,7 +46,8 @@ class SimpleTokenizer:
         self.vocab_size = vocab_size
 
     def encode(self, text: str) -> list[int]:
-        toks = re.findall(r"\w+|[^\w\s]", text.lower())
+        # [mask] must survive as one token, not '[', 'mask', ']'
+        toks = re.findall(r"\[mask\]|\w+|[^\w\s]", text.lower())
         ids = [self.CLS]
         for t in toks:
             if t == "[mask]":

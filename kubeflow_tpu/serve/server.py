@@ -49,6 +49,13 @@ class DataPlane:
     def register(self, model: Model, batcher: BatcherConfig | None = None) -> None:
         self._models[model.name] = model
         if batcher is not None:
+            buckets = getattr(model, "buckets", None)
+            if buckets is not None and batcher.max_batch_size > buckets.batch_sizes[-1]:
+                # a chunk larger than the top bucket would fail every caller
+                batcher = BatcherConfig(
+                    max_batch_size=buckets.batch_sizes[-1],
+                    max_latency_ms=batcher.max_latency_ms,
+                )
             self._batchers[model.name] = Batcher(
                 handler=lambda flat, m=model: self._predict_flat(m, flat),
                 config=batcher,
@@ -75,8 +82,15 @@ class DataPlane:
         y = model.predict(x)
         out = model.postprocess(y)
         if isinstance(out, dict) and "predictions" in out:
-            return list(out["predictions"])
-        return list(out)
+            out = out["predictions"]
+        out = list(out)
+        if len(out) != len(flat):
+            # a silent mismatch would slice wrong results back to callers
+            raise RuntimeError(
+                f"model '{model.name}' returned {len(out)} predictions "
+                f"for {len(flat)} instances"
+            )
+        return out
 
     async def infer(self, name: str, payload: Any, headers=None) -> Any:
         model = self.get(name)
@@ -148,10 +162,16 @@ class ModelServer:
         return web.json_response({"name": m.name, "ready": m.ready})
 
     async def _v1_predict(self, req: web.Request) -> web.Response:
-        body = await req.json()
         name = req.match_info["name"]
-        protocol.decode_v1(body)  # validate shape of the envelope
-        result = await self.dataplane.infer(name, body, dict(req.headers))
+        try:
+            body = await req.json()
+            protocol.decode_v1(body)  # validate shape of the envelope
+        except Exception as e:  # malformed client input is 400, not 500
+            raise web.HTTPBadRequest(reason=str(e))
+        try:
+            result = await self.dataplane.infer(name, body, dict(req.headers))
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e))
         return web.json_response(protocol.encode_v1(result))
 
     async def _v2_ready(self, req: web.Request) -> web.Response:
@@ -165,14 +185,22 @@ class ModelServer:
         )
 
     async def _v2_infer(self, req: web.Request) -> web.Response:
-        body = await req.json()
         name = req.match_info["name"]
-        tensors = protocol.decode_v2(body)
+        try:
+            body = await req.json()
+            tensors = protocol.decode_v2(body)
+            if not tensors:
+                raise ValueError("v2 request has no input tensors")
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=str(e))
         ids = tensors.get("input_ids")
         payload = {"instances": ids.tolist()} if ids is not None else {
             "instances": next(iter(tensors.values())).tolist()
         }
-        result = await self.dataplane.infer(name, payload, dict(req.headers))
+        try:
+            result = await self.dataplane.infer(name, payload, dict(req.headers))
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e))
         preds = result["predictions"] if isinstance(result, dict) else result
         import numpy as np
 

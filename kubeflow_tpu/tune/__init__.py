@@ -1,0 +1,33 @@
+"""AutoML plane: the Katib equivalent (SURVEY.md §2.3, §7 step 6).
+
+- ``spec``      — Experiment / Trial / search-space / objective types.
+- ``suggest``   — suggestion algorithms behind one interface: random, grid,
+                  bayesian (GP+EI), TPE, hyperband, CMA-ES.
+- ``metrics``   — metrics collectors: stdout-regex scraper (zero-SDK, the
+                  Katib sidecar trick) and TFEvents reader.
+- ``earlystop`` — median-stop early stopping.
+- ``controller``— Experiment controller: parallel trials through callables
+                  or the orchestrator, optimal tracking, goal completion.
+- ``service``   — gRPC suggestion service boundary (Katib's algorithm-pod
+                  analog), JSON payloads over grpc generic handlers.
+"""
+
+from kubeflow_tpu.tune.spec import (
+    ExperimentSpec,
+    Objective,
+    ObjectiveType,
+    ParameterSpec,
+    TrialAssignment,
+)
+from kubeflow_tpu.tune.suggest import make_suggester
+from kubeflow_tpu.tune.controller import ExperimentController
+
+__all__ = [
+    "ExperimentSpec",
+    "Objective",
+    "ObjectiveType",
+    "ParameterSpec",
+    "TrialAssignment",
+    "make_suggester",
+    "ExperimentController",
+]

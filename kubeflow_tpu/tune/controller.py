@@ -1,0 +1,300 @@
+"""Experiment controller: drive suggestions → parallel trials → optimum.
+
+Reference analog: [katib] pkg/controller.v1beta1/{experiment,trial}/
+(UNVERIFIED, mount empty, SURVEY.md §0, call stack §3.4): the experiment
+controller asks the Suggestion service for N parameter sets, creates Trials
+(each a Job/PyTorchJob from the trial template), watches metrics, tracks the
+optimal trial, and completes on goal or maxTrialCount.
+
+Two trial runners:
+
+- ``CallableTrialRunner`` — trial = in-process function(parameters) →
+  objective (the unit-test path, and the "tune a jitted train step on this
+  chip" fast path: 16 trials of a small model can share one TPU).
+- ``JobTrialRunner``      — trial = JAXJob through the orchestrator
+  (``LocalCluster``): template → ``JobSpec`` with ``${trialParameters.x}``
+  substituted, metrics scraped from worker rank-0 logs with the §5.5 regex
+  scraper — the gang-scheduled path of §3.4.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+from kubeflow_tpu.tune import metrics as metrics_mod
+from kubeflow_tpu.tune.earlystop import make_early_stopper
+from kubeflow_tpu.tune.spec import (
+    ExperimentSpec,
+    ObjectiveType,
+    Trial,
+    TrialAssignment,
+    TrialState,
+    substitute_template,
+)
+from kubeflow_tpu.tune.suggest import Suggester, make_suggester
+
+
+class TrialRunner:
+    """Runs one trial to completion, filling observations/metrics/state."""
+
+    def run(self, trial: Trial, experiment: ExperimentSpec) -> None:
+        raise NotImplementedError
+
+    def stop(self, trial: Trial) -> None:  # early-stop hook
+        pass
+
+
+class CallableTrialRunner(TrialRunner):
+    def __init__(
+        self,
+        fn: Callable[[dict], float | dict[str, float] | list[tuple[int, float]]],
+    ):
+        self.fn = fn
+
+    def run(self, trial: Trial, experiment: ExperimentSpec) -> None:
+        obj = experiment.objective
+        try:
+            result = self.fn(dict(trial.assignment.parameters))
+        except Exception as e:
+            trial.state = TrialState.FAILED
+            trial.message = repr(e)
+            return
+        if isinstance(result, list):  # [(step, value), ...] curve
+            trial.observations = list(result)
+            trial.metrics[obj.metric] = result[-1][1]
+        elif isinstance(result, Mapping):
+            trial.metrics.update(result)
+        else:
+            trial.metrics[obj.metric] = float(result)
+        if obj.metric in trial.metrics:
+            trial.metrics["__objective__"] = trial.metrics[obj.metric]
+        elif trial.observations:
+            trial.metrics["__objective__"] = trial.observations[-1][1]
+        trial.state = TrialState.SUCCEEDED
+
+
+class JobTrialRunner(TrialRunner):
+    """Trial = a JobSpec submitted to the orchestrator's LocalCluster."""
+
+    def __init__(self, cluster, *, poll_s: float = 0.2, timeout_s: float = 300.0):
+        self.cluster = cluster
+        self.poll_s = poll_s
+        self.timeout_s = timeout_s
+        self._uids: dict[str, str] = {}
+
+    def run(self, trial: Trial, experiment: ExperimentSpec) -> None:
+        from kubeflow_tpu.orchestrator.spec import JobSpec
+
+        obj = experiment.objective
+        manifest = substitute_template(
+            dict(experiment.trial_template), trial.assignment.parameters
+        )
+        manifest["name"] = f"{experiment.name}-{trial.assignment.trial_id}"
+        spec = JobSpec.from_dict(manifest)
+        uid = self.cluster.submit(spec)
+        self._uids[trial.assignment.trial_id] = uid
+        trial.state = TrialState.RUNNING
+        deadline = time.monotonic() + self.timeout_s
+        terminal = None
+        while time.monotonic() < deadline:
+            status = self.cluster.status(uid)
+            if status is not None and status.phase in ("Succeeded", "Failed"):
+                terminal = status.phase
+                break
+            time.sleep(self.poll_s)
+        log_text = self._logs(uid, spec)
+        series = metrics_mod.collect_from_text(
+            log_text, obj.metric, obj.additional_metrics
+        )
+        mine = series.get(obj.metric.lower(), [])
+        trial.observations = mine
+        minimize = obj.type is ObjectiveType.MINIMIZE
+        val = metrics_mod.best(mine, minimize)
+        if terminal == "Succeeded" and val is not None:
+            trial.metrics[obj.metric] = mine[-1][1]
+            trial.metrics["__objective__"] = val
+            for extra in obj.additional_metrics:
+                v = metrics_mod.latest(series.get(extra.lower(), []))
+                if v is not None:
+                    trial.metrics[extra] = v
+            trial.state = TrialState.SUCCEEDED
+        else:
+            trial.state = TrialState.FAILED
+            trial.message = (
+                f"phase={terminal or 'Timeout'}, metric_found={val is not None}"
+            )
+            if terminal is None:  # hung job: release its gang claim
+                try:
+                    self.cluster.delete(uid)
+                except Exception:
+                    pass
+
+    def _logs(self, uid: str, spec) -> str:
+        texts = []
+        for rtype in spec.replica_order():
+            try:
+                texts.append(self.cluster.logs(uid, rtype, 0))
+            except Exception:
+                pass
+        return "\n".join(texts)
+
+    def stop(self, trial: Trial) -> None:
+        uid = self._uids.get(trial.assignment.trial_id)
+        if uid is not None:
+            try:
+                self.cluster.delete(uid)
+            except Exception:
+                pass
+
+
+@dataclasses.dataclass
+class ExperimentStatus:
+    trials: list[Trial]
+    optimal: Trial | None
+    succeeded: int
+    failed: int
+    early_stopped: int
+    complete: bool
+    reason: str
+
+
+class ExperimentController:
+    def __init__(
+        self,
+        spec: ExperimentSpec,
+        runner: TrialRunner,
+        *,
+        suggester: Suggester | None = None,
+        seed: int = 0,
+    ):
+        spec.validate()
+        self.spec = spec
+        self.runner = runner
+        self.suggester = suggester or make_suggester(spec, seed)
+        self.trials: list[Trial] = []
+        self._lock = threading.Lock()
+        self._stopper = make_early_stopper(spec.early_stopping, spec.objective)
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self) -> ExperimentStatus:
+        spec = self.spec
+        obj = spec.objective
+        reason = "max_trial_count reached"
+        with cf.ThreadPoolExecutor(max_workers=spec.parallel_trial_count) as pool:
+            pending: set[cf.Future] = set()
+            while True:
+                done_count = len(self._terminal())
+                if self._failed_count() > spec.max_failed_trial_count:
+                    reason = "max_failed_trial_count exceeded"
+                    break
+                if self._goal_reached():
+                    reason = "objective goal reached"
+                    break
+                if done_count >= spec.max_trial_count:
+                    break
+                budget = spec.max_trial_count - len(self.trials)
+                want = min(spec.parallel_trial_count - len(pending), budget)
+                if want > 0:
+                    suggestions = self.suggester.suggest(want, self._history())
+                    if not suggestions and not pending:
+                        reason = "search space exhausted"
+                        break
+                    for a in suggestions:
+                        t = Trial(assignment=a)
+                        with self._lock:
+                            self.trials.append(t)
+                        pending.add(pool.submit(self._run_one, t))
+                if not pending:
+                    continue
+                finished, pending = cf.wait(
+                    pending, return_when=cf.FIRST_COMPLETED
+                )
+                for f in finished:
+                    f.result()  # surface runner crashes
+            for f in pending:  # drain in-flight trials before reporting
+                f.result()
+        return self.status(complete=True, reason=reason)
+
+    def _run_one(self, trial: Trial) -> None:
+        trial.state = TrialState.RUNNING
+        self.runner.run(trial, self.spec)
+        if self._stopper is not None and trial.state is TrialState.SUCCEEDED:
+            # retroactive medianstop: mark hopeless completed trials so the
+            # suggester's history de-weights them (in-process trials finish
+            # too fast to interrupt mid-flight; Job trials get stop()ed).
+            with self._lock:
+                others = [t for t in self.trials if t is not trial]
+                if self._stopper.should_stop(trial, others):
+                    trial.state = TrialState.EARLY_STOPPED
+                    self.runner.stop(trial)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _terminal(self) -> list[Trial]:
+        with self._lock:
+            return [
+                t
+                for t in self.trials
+                if t.state
+                in (
+                    TrialState.SUCCEEDED,
+                    TrialState.FAILED,
+                    TrialState.EARLY_STOPPED,
+                    TrialState.KILLED,
+                )
+            ]
+
+    def _failed_count(self) -> int:
+        with self._lock:
+            return sum(t.state is TrialState.FAILED for t in self.trials)
+
+    def _history(self) -> list[tuple[dict, float]]:
+        with self._lock:
+            return [
+                (dict(t.assignment.parameters), t.metrics["__objective__"])
+                for t in self.trials
+                if t.state is TrialState.SUCCEEDED and "__objective__" in t.metrics
+            ]
+
+    def _goal_reached(self) -> bool:
+        obj = self.spec.objective
+        return any(obj.reached(v) for _, v in self._history())
+
+    def optimal_trial(self) -> Trial | None:
+        obj = self.spec.objective
+        best: Trial | None = None
+        for t in self.trials:
+            v = t.metrics.get("__objective__")
+            if v is None:
+                continue
+            if best is None or obj.better(v, best.metrics["__objective__"]):
+                best = t
+        return best
+
+    def status(self, *, complete: bool = False, reason: str = "") -> ExperimentStatus:
+        with self._lock:
+            trials = list(self.trials)
+        return ExperimentStatus(
+            trials=trials,
+            optimal=self.optimal_trial(),
+            succeeded=sum(t.state is TrialState.SUCCEEDED for t in trials),
+            failed=sum(t.state is TrialState.FAILED for t in trials),
+            early_stopped=sum(t.state is TrialState.EARLY_STOPPED for t in trials),
+            complete=complete,
+            reason=reason,
+        )
+
+
+def tune(
+    fn: Callable[[dict], float],
+    spec: ExperimentSpec,
+    *,
+    seed: int = 0,
+) -> ExperimentStatus:
+    """KatibClient.tune() analog: one-call hyperparameter search."""
+    return ExperimentController(spec, CallableTrialRunner(fn), seed=seed).run()

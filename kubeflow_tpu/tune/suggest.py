@@ -1,0 +1,313 @@
+"""Suggestion algorithms behind one interface.
+
+Reference analog: [katib] pkg/suggestion/v1beta1/{hyperopt,optuna,skopt,
+hyperband,...}/service.py behind the ``GetSuggestions`` gRPC proto
+(UNVERIFIED, mount empty, SURVEY.md §0). The image has none of those
+libraries (SURVEY.md §0), so the algorithms are first-party:
+
+- ``random``    — uniform over the (log-aware) feasible space;
+- ``grid``      — cartesian grid sweep;
+- ``bayesian``  — GP regression (sklearn, Matérn) + expected improvement,
+                  the skopt-service analog;
+- ``tpe``       — Tree-structured Parzen Estimator (hyperopt-service analog);
+- ``cmaes``     — (μ/μ_w, λ) CMA-ES (optuna-cmaes analog);
+- ``hyperband`` — successive-halving budget scheduler.
+
+All optimizers work in the unit cube; ``ParameterSpec`` handles the
+log/int/categorical mapping.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random as _random
+from typing import Sequence
+
+import numpy as np
+
+from kubeflow_tpu.tune.spec import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    ObjectiveType,
+    ParameterSpec,
+    TrialAssignment,
+)
+
+
+class Suggester:
+    """GetSuggestions interface: observations in, new assignments out."""
+
+    def __init__(self, spec: ExperimentSpec, seed: int = 0):
+        self.spec = spec
+        self.params = spec.parameters
+        self.rng = _random.Random(seed)
+
+    def suggest(
+        self,
+        count: int,
+        history: Sequence[tuple[dict, float]],  # (parameters, objective)
+    ) -> list[TrialAssignment]:
+        raise NotImplementedError
+
+    # helpers ---------------------------------------------------------------
+
+    def _random_point(self) -> dict:
+        return {p.name: p.from_unit(self.rng.random()) for p in self.params}
+
+    def _to_unit_row(self, parameters: dict) -> list[float]:
+        return [p.to_unit(parameters[p.name]) for p in self.params]
+
+    def _from_unit_row(self, row: Sequence[float]) -> dict:
+        return {p.name: p.from_unit(u) for p, u in zip(self.params, row)}
+
+    def _sign(self) -> float:
+        """Internally always minimize: flip maximize objectives."""
+        return 1.0 if self.spec.objective.type is ObjectiveType.MINIMIZE else -1.0
+
+
+class RandomSuggester(Suggester):
+    def suggest(self, count, history):
+        return [TrialAssignment(self._random_point()) for _ in range(count)]
+
+
+class GridSuggester(Suggester):
+    def __init__(self, spec: ExperimentSpec, seed: int = 0):
+        super().__init__(spec, seed)
+        n = int(spec.algorithm.settings.get("points_per_dim", 5))
+        axes = [p.grid(n) for p in self.params]
+        self._points = [
+            dict(zip([p.name for p in self.params], combo))
+            for combo in itertools.product(*axes)
+        ]
+        self._cursor = 0
+
+    def suggest(self, count, history):
+        out = []
+        while count > 0 and self._cursor < len(self._points):
+            out.append(TrialAssignment(self._points[self._cursor]))
+            self._cursor += 1
+            count -= 1
+        return out  # exhausted grid returns fewer (controller completes)
+
+
+class BayesianSuggester(Suggester):
+    """GP + expected improvement over the unit cube.
+
+    sklearn's Matérn-5/2 GP with normalized y; EI maximized by random
+    multistart (cheap and dimension-robust — no scipy optimizer state).
+    """
+
+    def __init__(self, spec: ExperimentSpec, seed: int = 0):
+        super().__init__(spec, seed)
+        s = spec.algorithm.settings
+        self.n_initial = int(s.get("n_initial", 5))
+        self.n_candidates = int(s.get("n_candidates", 1024))
+        self.xi = float(s.get("xi", 0.01))
+
+    def suggest(self, count, history):
+        if len(history) < self.n_initial:
+            return [TrialAssignment(self._random_point()) for _ in range(count)]
+
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import ConstantKernel, Matern
+
+        X = np.array([self._to_unit_row(p) for p, _ in history])
+        y = self._sign() * np.array([v for _, v in history])
+        gp = GaussianProcessRegressor(
+            kernel=ConstantKernel(1.0) * Matern(nu=2.5),
+            normalize_y=True,
+            alpha=1e-6,
+            random_state=self.rng.randrange(2**31),
+        )
+        import warnings
+
+        with warnings.catch_warnings():
+            # small-sample kernel-hyperparam fits hit lbfgs iteration caps;
+            # an approximate fit is fine for EI ranking
+            warnings.simplefilter("ignore")
+            gp.fit(X, y)
+        best = y.min()
+
+        out: list[TrialAssignment] = []
+        for _ in range(count):
+            cand = np.array(
+                [[self.rng.random() for _ in self.params]
+                 for _ in range(self.n_candidates)]
+            )
+            mu, sigma = gp.predict(cand, return_std=True)
+            sigma = np.maximum(sigma, 1e-9)
+            imp = best - mu - self.xi
+            z = imp / sigma
+            ei = imp * _norm_cdf(z) + sigma * _norm_pdf(z)
+            # penalize points already picked this batch (batch diversity)
+            for a in out:
+                d = np.linalg.norm(cand - np.array(self._to_unit_row(a.parameters)), axis=1)
+                ei = np.where(d < 0.05, -np.inf, ei)
+            out.append(TrialAssignment(self._from_unit_row(cand[int(np.argmax(ei))])))
+        return out
+
+
+def _norm_pdf(z):
+    return np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+
+
+def _norm_cdf(z):
+    from scipy.special import ndtr
+
+    return ndtr(z)
+
+
+class TPESuggester(Suggester):
+    """Tree-structured Parzen Estimator: model p(x|good) / p(x|bad).
+
+    Per-dimension 1-D Parzen windows (Gaussian KDE over unit interval),
+    candidates drawn from the good-KDE, ranked by likelihood ratio l(x)/g(x)
+    — the hyperopt formulation.
+    """
+
+    def __init__(self, spec: ExperimentSpec, seed: int = 0):
+        super().__init__(spec, seed)
+        s = spec.algorithm.settings
+        self.n_initial = int(s.get("n_initial", 5))
+        self.gamma = float(s.get("gamma", 0.25))
+        self.n_candidates = int(s.get("n_candidates", 64))
+
+    def suggest(self, count, history):
+        if len(history) < self.n_initial:
+            return [TrialAssignment(self._random_point()) for _ in range(count)]
+
+        X = np.array([self._to_unit_row(p) for p, _ in history])
+        y = self._sign() * np.array([v for _, v in history])
+        order = np.argsort(y)
+        n_good = max(1, int(math.ceil(self.gamma * len(y))))
+        good, bad = X[order[:n_good]], X[order[n_good:]]
+        if len(bad) == 0:
+            bad = X
+
+        out = []
+        rng = np.random.default_rng(self.rng.randrange(2**31))
+        bw = max(0.05, 1.0 / max(1, len(good)) ** 0.5)
+        for _ in range(count):
+            row = []
+            for d in range(len(self.params)):
+                centers = good[:, d]
+                cands = np.clip(
+                    rng.choice(centers, self.n_candidates)
+                    + rng.normal(0, bw, self.n_candidates),
+                    0, 1,
+                )
+                lg = _parzen_logpdf(cands, centers, bw)
+                lb = _parzen_logpdf(cands, bad[:, d], bw)
+                row.append(float(cands[int(np.argmax(lg - lb))]))
+            out.append(TrialAssignment(self._from_unit_row(row)))
+        return out
+
+
+def _parzen_logpdf(x: np.ndarray, centers: np.ndarray, bw: float) -> np.ndarray:
+    d = (x[:, None] - centers[None, :]) / bw
+    log_k = -0.5 * d * d - math.log(bw * math.sqrt(2 * math.pi))
+    m = log_k.max(axis=1, keepdims=True)
+    return (m + np.log(np.exp(log_k - m).sum(axis=1, keepdims=True))).ravel() - math.log(
+        len(centers)
+    )
+
+
+class CMAESSuggester(Suggester):
+    """(μ/μ_w, λ) CMA-ES in the unit cube, diagonal covariance variant."""
+
+    def __init__(self, spec: ExperimentSpec, seed: int = 0):
+        super().__init__(spec, seed)
+        d = len(self.params)
+        self.mean = np.full(d, 0.5)
+        self.sigma = float(spec.algorithm.settings.get("sigma0", 0.3))
+        self.C = np.ones(d)  # diagonal covariance
+        self._seen = 0
+
+    def suggest(self, count, history):
+        rng = np.random.default_rng(self.rng.randrange(2**31))
+        # update distribution from any new completed trials
+        if len(history) > self._seen and len(history) >= 4:
+            X = np.array([self._to_unit_row(p) for p, _ in history])
+            y = self._sign() * np.array([v for _, v in history])
+            mu = max(2, len(y) // 4)
+            elite = X[np.argsort(y)[:mu]]
+            w = np.log(mu + 0.5) - np.log(np.arange(1, mu + 1))
+            w = w / w.sum()
+            new_mean = (w[:, None] * elite).sum(0)
+            var = (w[:, None] * (elite - self.mean) ** 2).sum(0)
+            self.C = 0.8 * self.C + 0.2 * var / max(self.sigma**2, 1e-12)
+            self.sigma = max(0.02, 0.9 * self.sigma)
+            self.mean = new_mean
+            self._seen = len(history)
+        pts = rng.normal(self.mean, self.sigma * np.sqrt(self.C), (count, len(self.params)))
+        return [TrialAssignment(self._from_unit_row(np.clip(r, 0, 1))) for r in pts]
+
+
+class HyperbandSuggester(Suggester):
+    """Successive halving: suggest() also assigns a per-trial budget.
+
+    The budget parameter (default ``epochs``) is injected into each
+    assignment; the controller runs trials at that budget and halving keeps
+    the top 1/eta fraction at eta× budget.
+    """
+
+    def __init__(self, spec: ExperimentSpec, seed: int = 0):
+        super().__init__(spec, seed)
+        s = spec.algorithm.settings
+        self.eta = int(s.get("eta", 3))
+        self.min_budget = int(s.get("min_budget", 1))
+        self.max_budget = int(s.get("max_budget", 27))
+        self.budget_param = s.get("budget_param", "epochs")
+        self._rungs: list[list[tuple[dict, float]]] = []
+        self._budget = self.min_budget
+
+    def suggest(self, count, history):
+        # promote survivors when a rung completes
+        completed = [(p, v) for p, v in history if p.get(self.budget_param) == self._budget]
+        rung_size = max(count, 1)
+        if completed and len(completed) >= rung_size and self._budget < self.max_budget:
+            sign = self._sign()
+            survivors = sorted(completed, key=lambda t: sign * t[1])[
+                : max(1, len(completed) // self.eta)
+            ]
+            self._budget = min(self.max_budget, self._budget * self.eta)
+            out = []
+            for p, _ in survivors[:count]:
+                q = dict(p)
+                q[self.budget_param] = self._budget
+                out.append(TrialAssignment(q))
+            while len(out) < count:
+                q = self._random_point()
+                q[self.budget_param] = self._budget
+                out.append(TrialAssignment(q))
+            return out
+        out = []
+        for _ in range(count):
+            q = self._random_point()
+            q[self.budget_param] = self._budget
+            out.append(TrialAssignment(q))
+        return out
+
+
+_REGISTRY = {
+    "random": RandomSuggester,
+    "grid": GridSuggester,
+    "bayesian": BayesianSuggester,
+    "skopt": BayesianSuggester,  # Katib algorithm-name alias
+    "tpe": TPESuggester,
+    "hyperopt": TPESuggester,  # alias
+    "cmaes": CMAESSuggester,
+    "hyperband": HyperbandSuggester,
+}
+
+
+def make_suggester(spec: ExperimentSpec, seed: int = 0) -> Suggester:
+    try:
+        cls = _REGISTRY[spec.algorithm.name]
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm '{spec.algorithm.name}' "
+            f"(have: {sorted(_REGISTRY)})"
+        ) from None
+    return cls(spec, seed)

@@ -271,6 +271,85 @@ def test_model_server_batching_path():
     assert b.stats["instances"] == 2
 
 
+def test_http_client_errors_are_400_not_500():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    server = ModelServer([_Doubler("dbl")])
+
+    async def run():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post("/v1/models/dbl:predict", json={})
+            assert r.status == 400
+            r = await client.post("/v2/models/dbl/infer", json={"inputs": []})
+            assert r.status == 400
+
+    asyncio.run(run())
+
+
+def test_dataplane_detects_prediction_count_mismatch():
+    from kubeflow_tpu.serve.server import DataPlane
+
+    class Broken(Model):
+        def predict(self, inputs, headers=None):
+            return {"predictions": [1]}  # wrong length vs instances
+
+    dp = DataPlane()
+    m = Broken("b")
+    m.ready = True
+    dp.register(m, BatcherConfig(max_batch_size=4, max_latency_ms=1))
+
+    async def run():
+        with pytest.raises(RuntimeError, match="returned 1 predictions"):
+            await dp.infer("b", {"instances": [[1], [2], [3]]})
+
+    asyncio.run(run())
+
+
+def test_batcher_clamped_to_bucket_max(devices8):
+    import jax.numpy as jnp
+
+    def apply_fn(params, ids, mask):
+        return (ids * mask).sum(-1)
+
+    m = JAXModel("toy", apply_fn, lambda: {},
+                 buckets=BucketSpec(batch_sizes=(1, 4), seq_lens=(8,)))
+    server = ModelServer([m], batcher=BatcherConfig(max_batch_size=64,
+                                                    max_latency_ms=1))
+    b = server.dataplane._batchers["toy"]
+    assert b.config.max_batch_size == 4  # clamped to the top batch bucket
+
+    async def run():  # 6 instances > top bucket: chunked, still correct
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post("/v1/models/toy:predict",
+                                  json={"instances": [[i] for i in range(6)]})
+            assert (await r.json())["predictions"] == list(range(6))
+
+    asyncio.run(run())
+
+
+def test_bf16_v2_roundtrip():
+    import ml_dtypes
+
+    arr = np.asarray([1.5, -2.0], ml_dtypes.bfloat16)
+    enc = protocol.InferTensor("w", arr).to_v2()
+    assert enc["datatype"] == "BF16"
+    dec = protocol.InferTensor.from_v2(enc)
+    back = dec.data.view(ml_dtypes.bfloat16)
+    assert back.tolist() == [1.5, -2.0]
+
+
+def test_tokenizer_emits_mask_token():
+    from kubeflow_tpu.serve.runtimes import SimpleTokenizer
+
+    tok = SimpleTokenizer(1024)
+    ids = tok.encode("the [MASK] ran")
+    assert ids[0] == tok.CLS and ids[-1] == tok.SEP
+    assert tok.MASK in ids
+    assert ids == tok.encode("the [MASK] ran")  # stable across calls
+
+
 # ------------------------------------------------------------------ storage
 
 
