@@ -1,0 +1,50 @@
+"""Pipelines plane: the KFP equivalent, TPU/local-native.
+
+Reference analog (SURVEY.md §2.4, [pipelines] repo — UNVERIFIED, mount
+empty §0): `@dsl.component` / `@dsl.pipeline` → PipelineSpec IR → Argo
+Workflow execution with driver/launcher pods, MLMD lineage, MinIO
+artifacts, cache server, ScheduledWorkflow controller.
+
+Here: decorators trace a Python pipeline function into a deterministic
+DAG IR; a DAG executor runs components either in-process or as JAXJobs
+through the orchestrator (the §3.5 "step creates a JAXJob" mapping);
+artifacts live in a local content-addressed store; the step cache and
+lineage store replace the cache server and MLMD.
+"""
+
+from kubeflow_tpu.pipelines.artifacts import (
+    Artifact,
+    ArtifactStore,
+    Dataset,
+    Metrics,
+    Model,
+)
+from kubeflow_tpu.pipelines.cache import StepCache
+from kubeflow_tpu.pipelines.compiler import compile_pipeline
+from kubeflow_tpu.pipelines.dsl import Input, Output, component, pipeline
+from kubeflow_tpu.pipelines.ir import ComponentIR, PipelineIR, TaskIR
+from kubeflow_tpu.pipelines.metadata import LineageStore
+from kubeflow_tpu.pipelines.runner import PipelineRunner, RunResult
+from kubeflow_tpu.pipelines.scheduler import RecurringRun, RunScheduler
+
+__all__ = [
+    "Artifact",
+    "ArtifactStore",
+    "ComponentIR",
+    "Dataset",
+    "Input",
+    "LineageStore",
+    "Metrics",
+    "Model",
+    "Output",
+    "PipelineIR",
+    "PipelineRunner",
+    "RecurringRun",
+    "RunResult",
+    "RunScheduler",
+    "StepCache",
+    "TaskIR",
+    "component",
+    "compile_pipeline",
+    "pipeline",
+]
