@@ -1,0 +1,159 @@
+"""Worker liveness heartbeats — the probe/peer-loss analog.
+
+The reference detects sick workers with kubelet liveness probes and (for
+SPMD gangs) the c10d/coordinator peer-loss abort (SURVEY.md §5.3). Exit
+deaths are already caught by the launcher's process monitor; what that
+misses is a *hung* worker — alive but stuck (deadlocked collective, wedged
+host callback). The heartbeat protocol covers that gap:
+
+- worker side: ``HeartbeatWriter`` touches a per-worker JSON file on a
+  background thread (and on every recorded step);
+- supervisor side (``kubeflow_tpu.orchestrator.supervisor``): a stale file
+  on a Running worker ⇒ kill it, letting the normal gang-restart +
+  checkpoint-restore path take over.
+
+The file lives in the job workdir, which the orchestrator shares across the
+gang (``KFT_WORKDIR``), so supervision needs no extra channel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+from kubeflow_tpu.orchestrator import envwire
+
+#: filename pattern inside the job workdir
+_FILE = "heartbeat-{rtype}-{index}.json"
+
+
+def heartbeat_path(workdir: str | Path, rtype: str, index: int) -> Path:
+    return Path(workdir) / _FILE.format(rtype=rtype, index=index)
+
+
+def heartbeat_path_from_env(env: dict[str, str] | None = None) -> Path | None:
+    """Resolve this worker's heartbeat file from the orchestrator wiring;
+    None when not running under a JAXJob gang."""
+    e = os.environ if env is None else env
+    workdir = e.get(envwire.ENV_WORKDIR)
+    rtype = e.get(envwire.ENV_REPLICA_TYPE)
+    index = e.get(envwire.ENV_REPLICA_INDEX)
+    if not (workdir and rtype and index is not None):
+        return None
+    return heartbeat_path(workdir, rtype, int(index))
+
+
+@dataclasses.dataclass
+class Heartbeat:
+    time: float
+    pid: int
+    step: int = -1
+    attempt: int = 0
+
+    def age(self, now: float | None = None) -> float:
+        return (time.time() if now is None else now) - self.time
+
+
+class HeartbeatWriter:
+    """Background beat + explicit ``beat(step=...)`` from the train loop."""
+
+    def __init__(
+        self,
+        path: str | Path,
+        *,
+        interval: float = 1.0,
+        attempt: int = 0,
+    ):
+        self.path = Path(path)
+        self.interval = interval
+        self.attempt = attempt
+        self._step = -1
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._write_lock = threading.Lock()
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    @classmethod
+    def from_env(cls, *, interval: float = 1.0) -> "HeartbeatWriter | None":
+        path = heartbeat_path_from_env()
+        if path is None:
+            return None
+        return cls(
+            path,
+            interval=interval,
+            attempt=int(os.environ.get(envwire.ENV_ATTEMPT, "0")),
+        )
+
+    def start(self) -> "HeartbeatWriter":
+        if self._thread is None:
+            self.beat()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.beat()
+
+    def beat(self, step: int | None = None) -> None:
+        if step is not None:
+            self._step = step
+        payload = json.dumps(
+            dataclasses.asdict(
+                Heartbeat(
+                    time=time.time(),
+                    pid=os.getpid(),
+                    step=self._step,
+                    attempt=self.attempt,
+                )
+            )
+        )
+        tmp = self.path.with_suffix(".tmp")
+        # Lock: the background thread and explicit beat(step) callers share
+        # one tmp file; unserialised, a replace could publish a truncated
+        # write and a torn read would look like a missing beat.
+        with self._write_lock:
+            tmp.write_text(payload)
+            os.replace(tmp, self.path)  # atomic: readers never see torn data
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def __enter__(self) -> "HeartbeatWriter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def read_heartbeat(path: str | Path) -> Heartbeat | None:
+    """None if the file is absent or torn (treat as 'no beat yet')."""
+    try:
+        d = json.loads(Path(path).read_text())
+        return Heartbeat(**d)
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+def is_stale(
+    path: str | Path,
+    timeout: float,
+    *,
+    min_attempt: int = 0,
+    now: float | None = None,
+) -> bool:
+    """True when the latest beat (of at least ``min_attempt``) is older than
+    ``timeout``. A missing file is NOT stale — the worker may not have
+    reached its first beat; the supervisor separately grace-periods startup.
+    """
+    hb = read_heartbeat(path)
+    if hb is None or hb.attempt < min_attempt:
+        return False
+    return hb.age(now) > timeout

@@ -1,0 +1,83 @@
+"""Structured JSON logging for every framework process.
+
+The reference's controllers log structured (zap/klog key-values) so fleet
+log pipelines can index reconcile events; SURVEY.md §5.5 carries that
+requirement over. One formatter, enabled per-process with
+``configure_json_logging()``; gang identity fields (job/replica/rank) are
+stamped automatically from the orchestrator's env wiring so every line from
+every worker is attributable without parsing free text.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+import traceback
+
+from kubeflow_tpu.orchestrator import envwire
+
+
+def _gang_identity() -> dict[str, str]:
+    out = {}
+    for field, var in (
+        ("job", envwire.ENV_JOB_NAME),
+        ("job_uid", envwire.ENV_JOB_UID),
+        ("replica_type", envwire.ENV_REPLICA_TYPE),
+        ("replica_index", envwire.ENV_REPLICA_INDEX),
+        ("attempt", envwire.ENV_ATTEMPT),
+    ):
+        v = os.environ.get(var)
+        if v is not None:
+            out[field] = v
+    return out
+
+
+class JsonFormatter(logging.Formatter):
+    def __init__(self, *, static_fields: dict[str, str] | None = None):
+        super().__init__()
+        self.static_fields = dict(static_fields or {})
+        self.static_fields.update(_gang_identity())
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(record.created, 6),
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            )
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+            **self.static_fields,
+        }
+        if record.exc_info and record.exc_info[0] is not None:
+            entry["exc"] = "".join(
+                traceback.format_exception(*record.exc_info)
+            ).rstrip()
+        extra = getattr(record, "fields", None)
+        if isinstance(extra, dict):
+            entry.update(extra)
+        return json.dumps(entry, default=str)
+
+
+def configure_json_logging(
+    level: int = logging.INFO,
+    *,
+    stream=None,
+    static_fields: dict[str, str] | None = None,
+) -> logging.Handler:
+    """Install a JSON handler on the root logger (replacing prior handlers
+    installed by this function; idempotent)."""
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        if getattr(h, "_kft_json", False):
+            root.removeHandler(h)
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(JsonFormatter(static_fields=static_fields))
+    handler._kft_json = True  # type: ignore[attr-defined]
+    root.addHandler(handler)
+    root.setLevel(level)
+    return handler

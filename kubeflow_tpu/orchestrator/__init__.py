@@ -22,6 +22,7 @@ Python reconciler engine over an in-process object store:
 
 from kubeflow_tpu.orchestrator.spec import (  # noqa: F401
     CleanPodPolicy,
+    ElasticPolicy,
     JobCondition,
     JobConditionType,
     JobSpec,

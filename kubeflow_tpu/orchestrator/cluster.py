@@ -15,6 +15,7 @@ import tempfile
 import threading
 import time
 
+from kubeflow_tpu.obs import prom
 from kubeflow_tpu.orchestrator.envwire import WiringConfig
 from kubeflow_tpu.orchestrator.gang import GangScheduler
 from kubeflow_tpu.orchestrator.launcher import ProcessLauncher
@@ -22,8 +23,16 @@ from kubeflow_tpu.orchestrator.reconciler import JobController, JobObject
 from kubeflow_tpu.orchestrator.resources import Fleet
 from kubeflow_tpu.orchestrator.spec import JobSpec, JobStatus
 from kubeflow_tpu.orchestrator.store import ObjectStore
+from kubeflow_tpu.orchestrator.supervisor import HeartbeatSupervisor
 
 logger = logging.getLogger(__name__)
+
+SYNC_SECONDS = prom.REGISTRY.histogram(
+    "kft_reconcile_seconds", "controller sync_all wall time"
+)
+JOBS_BY_PHASE = prom.REGISTRY.gauge(
+    "kft_jobs", "jobs currently in the store by phase", labels=("phase",)
+)
 
 
 class LocalCluster:
@@ -50,6 +59,9 @@ class LocalCluster:
             self.launcher,
             self.wiring,
             restart_backoff_base=restart_backoff_base,
+        )
+        self.supervisor = HeartbeatSupervisor(
+            self.jobs, self.workers, self.launcher
         )
         self._resync = resync_period
         self._wake = threading.Event()
@@ -82,7 +94,16 @@ class LocalCluster:
             self._wake.clear()
             if self._stop.is_set():
                 return
-            self.controller.sync_all()
+            with SYNC_SECONDS.time():
+                self.supervisor.check()
+                self.controller.sync_all()
+            phases: dict[str, int] = {}
+            for _, job in self.jobs.list():
+                phases[job.status.phase] = phases.get(job.status.phase, 0) + 1
+            # "Unknown" = submitted but not yet reconciled (no conditions)
+            for phase in ("Unknown", "Created", "Queued", "Running",
+                          "Restarting", "Succeeded", "Failed"):
+                JOBS_BY_PHASE.labels(phase=phase).set(phases.get(phase, 0))
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -152,6 +173,13 @@ class LocalCluster:
             f"job {uid} not finished after {timeout}s "
             f"(phase {last.phase if last else 'Unknown'})"
         )
+
+    def scale(self, uid: str, replicas: int) -> int:
+        """Resize an elastic job's scalable group (HPA analog); the gang
+        re-forms at the new size and resumes from checkpoint."""
+        applied = self.controller.scale(uid, replicas)
+        self._wake.set()
+        return applied
 
     def logs(self, uid: str, rtype: str, index: int, attempt: int | None = None) -> str:
         """Concatenated (or single-attempt) worker logs."""

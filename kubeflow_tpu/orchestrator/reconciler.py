@@ -23,6 +23,7 @@ import dataclasses
 import logging
 import time
 
+from kubeflow_tpu.obs import prom
 from kubeflow_tpu.orchestrator import envwire
 from kubeflow_tpu.orchestrator.gang import GangScheduler, PodGroup
 from kubeflow_tpu.orchestrator.launcher import ProcessLauncher
@@ -40,6 +41,14 @@ from kubeflow_tpu.orchestrator.store import ObjectStore
 
 logger = logging.getLogger(__name__)
 
+GANG_RESTARTS = prom.REGISTRY.counter(
+    "kft_gang_restarts_total", "gang restarts triggered by worker failures"
+)
+JOBS_FINISHED = prom.REGISTRY.counter(
+    "kft_jobs_finished_total", "jobs reaching a terminal condition",
+    labels=("condition", "reason"),
+)
+
 
 @dataclasses.dataclass
 class JobObject:
@@ -50,6 +59,8 @@ class JobObject:
     coordinator_port: int = 0
     next_restart_at: float = 0.0
     deletion_requested: bool = False
+    #: pending elastic resize target for the scalable group (None = none).
+    resize_to: int | None = None
 
 
 class JobController:
@@ -94,6 +105,13 @@ class JobController:
             self._cleanup(job, kill_all=True)
             self._delete_records(uid)
             return
+
+        if job.resize_to is not None and not status.finished:
+            self._apply_resize(job)
+            job = self.jobs.get(uid)
+            if job is None:
+                return
+            spec, status = job.spec, job.status
 
         if status.finished:
             self._maybe_ttl(job)
@@ -266,6 +284,7 @@ class JobController:
             return
 
         # Gang restart: kill survivors, re-schedule everyone.
+        GANG_RESTARTS.inc()
         status.restart_count += 1
         status.push(
             CT.RESTARTING, reason="GangRestart",
@@ -286,6 +305,77 @@ class JobController:
         self._wait_dead(ws)
         for w in ws:
             self.workers.mutate(w.key, _reset_for_restart)
+
+    def scale(self, uid: str, replicas: int) -> int:
+        """Resize an elastic job's scalable replica group — the HPA-driven
+        path of the reference's ElasticPolicy, restart-shaped for SPMD
+        (SURVEY.md §2.6 "Elastic DP"): the gang re-forms at the new world
+        size and training resumes from checkpoint onto the reshaped mesh.
+        Returns the (clamped) size actually applied.
+
+        This only records the target and flags the resize; the reconcile
+        loop performs the spec mutation and kill/reset mechanics
+        (``_apply_resize``) so they can't race its own passes — mutating
+        ``spec.replicas`` here could make a sync already past the resize
+        check launch claim-less extra workers, and a worker killed outside
+        the loop could be misread as a crash that burns backoff budget.
+        """
+        job: JobObject | None = self.jobs.get(uid)
+        if job is None:
+            raise KeyError(f"job {uid} not found")
+        if job.spec.elastic is None:
+            raise ValueError(f"job {job.spec.name} has no elastic policy")
+        if job.status.finished:
+            raise ValueError(f"job {job.spec.name} already finished")
+        policy = job.spec.elastic
+        replicas = policy.clamp(replicas)
+        rtype = policy.replica_type
+        current = (
+            job.resize_to
+            if job.resize_to is not None
+            else job.spec.replicas[rtype].replicas
+        )
+        if replicas == current:
+            return replicas
+
+        # Not a failure: scaling doesn't consume backoff budget.
+        job.status.push(
+            CT.RESTARTING, reason="Scaled",
+            message=f"{rtype} resizing to {replicas}; gang re-forming",
+        )
+        job.resize_to = replicas
+        self.jobs.update(uid, job)
+        logger.info("job %s scaling %s to %d replicas", job.spec.name, rtype, replicas)
+        return replicas
+
+    def _apply_resize(self, job: JobObject) -> None:
+        """Reconcile-loop half of ``scale``: apply the new size to the spec,
+        tear the old gang down, and drop every record so the next sync
+        rebuilds the desired set at the new size with fresh attempt counters
+        and gang claims."""
+        from kubeflow_tpu.obs.heartbeat import heartbeat_path
+
+        uid = job.spec.uid
+        rtype = job.spec.elastic.replica_type
+        job.spec.replicas[rtype] = dataclasses.replace(
+            job.spec.replicas[rtype], replicas=job.resize_to
+        )
+        ws = [w for _, w in self.workers.list(prefix=f"{uid}/")]
+        for w in ws:
+            if w.phase is WorkerPhase.RUNNING:
+                self.launcher.kill(w.key)
+        self._wait_dead(ws)
+        # Heartbeat files must go too — attempt counters restart at 0, so a
+        # stale pre-scale beat would otherwise read as a hung new attempt.
+        for w in ws:
+            self.workers.delete(w.key)
+            heartbeat_path(
+                self.launcher.workdir(uid), w.replica_type, w.index
+            ).unlink(missing_ok=True)
+        self.scheduler.cancel(uid)
+        job.resize_to = None
+        job.coordinator_port = envwire.free_port()
+        self.jobs.update(uid, job)
 
     def _rank0_worker(
         self, spec: JobSpec, ws: list[WorkerStatus]
@@ -321,6 +411,7 @@ class JobController:
         self, job: JobObject, ctype: CT, reason: str, message: str
     ) -> None:
         job.status.push(ctype, reason=reason, message=message)
+        JOBS_FINISHED.labels(condition=ctype.value, reason=reason).inc()
         job.status.completion_time = time.time()
         self._cleanup(
             job,

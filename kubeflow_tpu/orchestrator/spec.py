@@ -137,6 +137,49 @@ class SchedulingPolicy:
 
 
 @dataclasses.dataclass(frozen=True)
+class ElasticPolicy:
+    """Checkpoint-restart elasticity (the PyTorchJob ElasticPolicy analog).
+
+    JAX SPMD worlds are static, so elasticity is restart-shaped (SURVEY.md
+    §5.3): ``scale()`` re-forms the gang at a new size and training resumes
+    from the latest checkpoint onto the reshaped mesh (Orbax re-shards on
+    load). ``min/max_replicas`` bound the scalable replica group;
+    ``heartbeat_timeout_seconds`` arms the supervisor's hung-worker
+    detection (exit deaths need no heartbeat — the launcher sees those).
+    """
+
+    replica_type: str = "worker"
+    min_replicas: int = 1
+    max_replicas: int | None = None
+    heartbeat_timeout_seconds: float | None = None
+    heartbeat_grace_seconds: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.max_replicas is not None and self.min_replicas > self.max_replicas:
+            raise ValueError(
+                f"min_replicas {self.min_replicas} > max_replicas "
+                f"{self.max_replicas}"
+            )
+
+    def clamp(self, replicas: int) -> int:
+        lo = max(1, self.min_replicas)
+        hi = self.max_replicas if self.max_replicas is not None else replicas
+        return max(lo, min(replicas, hi))
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ElasticPolicy":
+        return cls(
+            replica_type=d.get("replica_type", "worker"),
+            min_replicas=int(d.get("min_replicas", 1)),
+            max_replicas=(
+                int(d["max_replicas"]) if d.get("max_replicas") is not None else None
+            ),
+            heartbeat_timeout_seconds=d.get("heartbeat_timeout_seconds"),
+            heartbeat_grace_seconds=float(d.get("heartbeat_grace_seconds", 30.0)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class RunPolicy:
     backoff_limit: int = 3
     active_deadline_seconds: float | None = None
@@ -205,6 +248,7 @@ class JobSpec:
     name: str
     replicas: dict[str, ReplicaSpec]
     run_policy: RunPolicy = dataclasses.field(default_factory=RunPolicy)
+    elastic: ElasticPolicy | None = None
     mesh: MeshSpec | None = None
     namespace: str = "default"
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -218,6 +262,11 @@ class JobSpec:
                 raise ValueError(f"replica group {rtype!r} needs replicas >= 1")
             if not spec.command:
                 raise ValueError(f"replica group {rtype!r} needs a command")
+        if self.elastic is not None and self.elastic.replica_type not in self.replicas:
+            raise ValueError(
+                f"elastic.replica_type {self.elastic.replica_type!r} "
+                "is not a replica group of this job"
+            )
 
     # ------------------------------------------------------------------ #
 
@@ -259,6 +308,11 @@ class JobSpec:
                 k: ReplicaSpec.from_dict(v) for k, v in d["replicas"].items()
             },
             run_policy=RunPolicy.from_dict(d.get("run_policy", {})),
+            elastic=(
+                ElasticPolicy.from_dict(d["elastic"])
+                if d.get("elastic") is not None
+                else None
+            ),
             mesh=MeshSpec.from_dict(mesh) if mesh else None,
             namespace=d.get("namespace", "default"),
             labels=dict(d.get("labels", {})),
@@ -277,6 +331,9 @@ class JobSpec:
                 "scheduling": dataclasses.asdict(self.run_policy.scheduling),
                 "success_policy": self.run_policy.success_policy.value,
             },
+            "elastic": (
+                dataclasses.asdict(self.elastic) if self.elastic else None
+            ),
             "mesh": self.mesh.to_dict() if self.mesh else None,
             "namespace": self.namespace,
             "labels": dict(self.labels),

@@ -1,0 +1,112 @@
+"""Heartbeat supervisor: hung-worker detection for elastic jobs.
+
+Failure detection in the reference stack is three-layered: process exit
+(kubelet), liveness probes, and the rendezvous layer's peer-loss abort
+(SURVEY.md §5.3). The launcher's monitor threads cover exits; the
+``jax.distributed`` coordinator covers peer loss once the world is up. The
+remaining hole — a worker that is alive but wedged (deadlocked collective,
+stuck host IO, hung before ``initialize``) — is covered here, the liveness
+probe analog:
+
+every supervisor pass, for each Running worker of a job whose
+``ElasticPolicy.heartbeat_timeout_seconds`` is armed, read the worker's
+heartbeat file (``kubeflow_tpu.obs.heartbeat``). If the newest beat of the
+*current attempt* is older than the timeout — or the worker has produced no
+beat within the startup grace — SIGKILL it. The launcher observes exit 137,
+and the normal gang-restart + checkpoint-restore machinery does the rest;
+the supervisor never touches job state directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from kubeflow_tpu.obs import heartbeat as hb
+from kubeflow_tpu.obs import prom
+from kubeflow_tpu.orchestrator.launcher import ProcessLauncher
+from kubeflow_tpu.orchestrator.spec import WorkerPhase, WorkerStatus
+from kubeflow_tpu.orchestrator.store import ObjectStore
+
+logger = logging.getLogger(__name__)
+
+KILLS = prom.REGISTRY.counter(
+    "kft_supervisor_kills_total",
+    "workers killed by the heartbeat supervisor",
+    labels=("reason",),
+)
+
+
+class HeartbeatSupervisor:
+    def __init__(
+        self,
+        jobs: ObjectStore,
+        workers: ObjectStore,
+        launcher: ProcessLauncher,
+    ):
+        self.jobs = jobs
+        self.workers = workers
+        self.launcher = launcher
+        #: (worker key, attempt, pid) → first time we saw it Running; grace
+        #: is measured from here so slow starts aren't executions. The pid
+        #: is part of the identity: an elastic resize recreates workers with
+        #: attempt 0, and without it the new process would inherit the old
+        #: one's clock and be killed mid-startup.
+        self._running_since: dict[tuple[str, int, int | None], float] = {}
+
+    def check(self, now: float | None = None) -> list[str]:
+        """One supervision pass; returns the keys it killed."""
+        now = time.time() if now is None else now
+        killed: list[str] = []
+        live: set[tuple[str, int, int | None]] = set()
+        for uid, job in self.jobs.list():
+            policy = job.spec.elastic
+            timeout = policy.heartbeat_timeout_seconds if policy else None
+            if timeout is None or job.status.finished:
+                continue
+            for _, w in self.workers.list(prefix=f"{uid}/"):
+                if w.phase is not WorkerPhase.RUNNING:
+                    continue
+                tag = (w.key, w.restarts, w.pid)
+                live.add(tag)
+                since = self._running_since.setdefault(tag, now)
+                if self._is_hung(job, w, since, timeout, now):
+                    if self.launcher.kill(w.key):
+                        killed.append(w.key)
+        # forget workers that restarted or went away
+        for tag in list(self._running_since):
+            if tag not in live:
+                del self._running_since[tag]
+        return killed
+
+    def _is_hung(
+        self,
+        job,
+        w: WorkerStatus,
+        running_since: float,
+        timeout: float,
+        now: float,
+    ) -> bool:
+        policy = job.spec.elastic
+        path = hb.heartbeat_path(
+            self.launcher.workdir(w.job_uid), w.replica_type, w.index
+        )
+        beat = hb.read_heartbeat(path)
+        if beat is None or beat.attempt < w.restarts:
+            # No beat from this attempt yet: hung only past the grace.
+            if now - running_since > policy.heartbeat_grace_seconds:
+                logger.warning(
+                    "killing %s: no heartbeat within grace %.1fs",
+                    w.key, policy.heartbeat_grace_seconds,
+                )
+                KILLS.labels(reason="no_heartbeat").inc()
+                return True
+            return False
+        if beat.age(now) > timeout:
+            logger.warning(
+                "killing %s: heartbeat stale %.1fs (timeout %.1fs, step %d)",
+                w.key, beat.age(now), timeout, beat.step,
+            )
+            KILLS.labels(reason="stale_heartbeat").inc()
+            return True
+        return False

@@ -204,6 +204,15 @@ class Trainer:
             cfg.metrics_logdir, is_writer=jax.process_index() == 0
         )
 
+        # Liveness: when launched by the orchestrator, beat automatically so
+        # the heartbeat supervisor can tell "compiling/training" from "hung"
+        # (SURVEY.md §5.3). No-op outside a gang.
+        from kubeflow_tpu.obs.heartbeat import HeartbeatWriter
+
+        hb = HeartbeatWriter.from_env()
+        if hb is not None:
+            hb.start()
+
         state = self.init_state()
         ckpt: Checkpointer | None = None
         start_step = 0
@@ -233,9 +242,11 @@ class Trainer:
             with jax.set_mesh(self.mesh):
                 return self._fit_loop(
                     state, step_fn, it, ckpt, writer, hooks, history,
-                    start_step, t_last, last_logged,
+                    start_step, t_last, last_logged, hb,
                 )
         finally:
+            if hb is not None:
+                hb.stop()
             if ckpt is not None:
                 ckpt.close()
             if own_writer:
@@ -243,7 +254,7 @@ class Trainer:
 
     def _fit_loop(
         self, state, step_fn, it, ckpt, writer, hooks, history,
-        start_step, t_last, last_logged,
+        start_step, t_last, last_logged, hb=None,
     ):
         cfg = self.config
         try:
@@ -252,6 +263,9 @@ class Trainer:
                 if ckpt is not None:
                     ckpt.save(step + 1, state)
                 if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                    if hb is not None:
+                        # stamp progress; the writer thread owns liveness
+                        hb.beat(step + 1)
                     m = {k: float(v) for k, v in metrics.items()}
                     now = time.perf_counter()
                     m["steps_per_sec"] = (step + 1 - last_logged) / (now - t_last)

@@ -1,0 +1,311 @@
+"""Elasticity + failure detection (SURVEY.md §5.3, §7 step 8).
+
+Three layers, mirroring the reference's test strategy (§4):
+- control-plane units: ElasticPolicy clamping, scale() state machine,
+  heartbeat-supervisor kills — trivial non-JAX payloads, fast;
+- fault injection e2e: SIGKILL a worker mid-MNIST-training, assert the gang
+  restarts and RESUMES from the Orbax checkpoint (not from step 0);
+- elastic-restart e2e: scale a 2-worker job down to 1 mid-run, assert
+  training resumes from checkpoint onto the reshaped (smaller) mesh.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.obs import heartbeat as hb
+from kubeflow_tpu.orchestrator import (
+    ElasticPolicy,
+    JobSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    TPURequest,
+    LocalCluster,
+)
+from kubeflow_tpu.orchestrator.envwire import WiringConfig
+from kubeflow_tpu.orchestrator.resources import Fleet
+from kubeflow_tpu.orchestrator.spec import JobConditionType as CT
+from kubeflow_tpu.train.metrics import parse_stdout_metrics
+
+REPO = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+
+#: hand-writes the heartbeat file per the documented JSON protocol (no
+#: framework import → child starts in milliseconds); beats once, then hangs
+#: beat-less on attempt 0 and exits clean on later attempts.
+HANG_THEN_OK = """
+import json, os, sys, time
+workdir = os.environ["KFT_WORKDIR"]
+rtype = os.environ["KFT_REPLICA_TYPE"]
+index = os.environ["KFT_REPLICA_INDEX"]
+attempt = int(os.environ["KFT_ATTEMPT"])
+path = os.path.join(workdir, f"heartbeat-{rtype}-{index}.json")
+def beat():
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"time": time.time(), "pid": os.getpid(),
+                   "step": -1, "attempt": attempt}, f)
+    os.replace(tmp, path)
+beat()
+if attempt == 0:
+    time.sleep(120)   # wedged: alive but never beats again
+else:
+    beat()
+    sys.exit(0)
+"""
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = LocalCluster(
+        fleet=Fleet.homogeneous(2, "2x2"),
+        base_dir=str(tmp_path),
+        restart_backoff_base=0.05,
+        resync_period=0.05,
+    )
+    with c:
+        yield c
+
+
+# -- control-plane units -------------------------------------------------- #
+
+
+def test_elastic_policy_clamp():
+    p = ElasticPolicy(min_replicas=2, max_replicas=4)
+    assert p.clamp(1) == 2
+    assert p.clamp(3) == 3
+    assert p.clamp(9) == 4
+    assert ElasticPolicy(min_replicas=1).clamp(7) == 7  # unbounded above
+
+
+def test_elastic_policy_rejects_inverted_bounds():
+    with pytest.raises(ValueError, match="min_replicas"):
+        ElasticPolicy(min_replicas=4, max_replicas=2)
+
+
+def test_spec_rejects_unknown_elastic_group():
+    with pytest.raises(ValueError, match="elastic.replica_type"):
+        JobSpec(
+            name="bad",
+            replicas={"worker": ReplicaSpec(command=("true",))},
+            elastic=ElasticPolicy(replica_type="trainer"),
+        )
+
+
+def test_scale_requires_elastic_policy(cluster):
+    spec = JobSpec(
+        name="static",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=1, command=(PY, "-c", "import time; time.sleep(60)")
+            )
+        },
+    )
+    uid = cluster.submit(spec)
+    deadline = time.time() + 30
+    while time.time() < deadline and cluster.status(uid).phase != "Running":
+        time.sleep(0.05)
+    with pytest.raises(ValueError, match="no elastic policy"):
+        cluster.scale(uid, 2)
+    cluster.delete(uid)
+
+
+def test_scale_reforms_gang_at_new_size(cluster):
+    spec = JobSpec(
+        name="elastic-sleep",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=2,
+                command=(PY, "-c", "import time; time.sleep(60)"),
+                tpu=TPURequest(chips=1),
+            )
+        },
+        elastic=ElasticPolicy(min_replicas=1, max_replicas=3),
+    )
+    uid = cluster.submit(spec)
+    deadline = time.time() + 30
+    while time.time() < deadline and cluster.status(uid).phase != "Running":
+        time.sleep(0.05)
+    assert cluster.status(uid).phase == "Running"
+
+    assert cluster.scale(uid, 5) == 3  # clamped to max
+    job = cluster.get(uid)
+    restarting = [c for c in job.status.conditions if c.type is CT.RESTARTING]
+    assert restarting and restarting[0].reason == "Scaled"
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        ws = list(cluster.workers.list(prefix=f"{uid}/"))
+        if len(ws) == 3 and all(
+            w.phase.value == "Running" for _, w in ws
+        ):
+            break
+        time.sleep(0.05)
+    ws = list(cluster.workers.list(prefix=f"{uid}/"))
+    assert len(ws) == 3
+    job = cluster.get(uid)
+    # the reconcile loop applied the new size to the spec...
+    assert job.spec.replicas["worker"].replicas == 3
+    # ...and scaling never burns failure-backoff budget
+    assert job.status.restart_count == 0
+    assert cluster.scale(uid, 3) == 3  # no-op resize is accepted
+    cluster.delete(uid)
+
+
+def test_supervisor_kills_hung_worker_and_gang_recovers(cluster):
+    spec = JobSpec(
+        name="hung",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=2,
+                command=(PY, "-c", HANG_THEN_OK),
+                restart_policy=RestartPolicy.ON_FAILURE,
+                tpu=TPURequest(chips=1),
+            )
+        },
+        elastic=ElasticPolicy(
+            heartbeat_timeout_seconds=0.4, heartbeat_grace_seconds=10.0
+        ),
+    )
+    uid = cluster.submit(spec)
+    status = cluster.wait(uid, timeout=60)
+    assert status.phase == "Succeeded", [
+        c.to_dict() for c in status.conditions
+    ]
+    # both workers hung on attempt 0 → supervisor killed them (137) →
+    # one gang restart → attempt 1 exits 0
+    assert status.restart_count == 1
+
+
+def test_supervisor_respects_startup_grace(cluster, tmp_path):
+    sup = cluster.supervisor
+    spec = JobSpec(
+        name="graceful",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=1,
+                # beats nothing at all, exits after 1.2s
+                command=(PY, "-c", "import time; time.sleep(1.2)"),
+            )
+        },
+        elastic=ElasticPolicy(
+            heartbeat_timeout_seconds=0.2, heartbeat_grace_seconds=30.0
+        ),
+    )
+    uid = cluster.submit(spec)
+    status = cluster.wait(uid, timeout=30)
+    # never killed: no beat ever arrived, but grace covered the lifetime
+    assert status.phase == "Succeeded"
+    assert status.restart_count == 0
+    assert sup.check() == []
+
+
+# -- data-plane e2e: fault injection + elastic restart -------------------- #
+
+
+def _mnist_job(tmp_path, *, replicas, steps, elastic=None, name="mnist"):
+    return JobSpec(
+        name=name,
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=replicas,
+                command=(
+                    PY, "-m", "kubeflow_tpu.examples.mnist",
+                    "--steps", str(steps), "--global-batch", "32",
+                    "--log-every", "1", "--lr", "3e-3",
+                    "--checkpoint-dir", str(tmp_path / "ckpt"),
+                    "--checkpoint-every", "2",
+                ),
+                env={"PYTHONPATH": REPO},
+                restart_policy=RestartPolicy.ON_FAILURE,
+                tpu=TPURequest(chips=4),
+            )
+        },
+        elastic=elastic,
+    )
+
+
+def _wait_for_step(cluster, uid, step, timeout=240):
+    """Poll worker-0 stdout until ``step=N`` appears (any attempt)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if any(
+            m["step"] >= step
+            for m in parse_stdout_metrics(cluster.logs(uid, "worker", 0))
+        ):
+            return
+        if cluster.status(uid).finished:
+            raise AssertionError(
+                f"job finished before reaching step {step}:\n"
+                + cluster.logs(uid, "worker", 0)
+            )
+        time.sleep(0.2)
+    raise TimeoutError(f"step {step} not reached; log:\n"
+                       + cluster.logs(uid, "worker", 0))
+
+
+@pytest.mark.slow
+def test_sigkill_worker_resumes_from_checkpoint(tmp_path):
+    cluster = LocalCluster(
+        fleet=Fleet.homogeneous(2, "2x2"),
+        wiring=WiringConfig(platform="cpu_sim", devices_per_worker=4),
+        base_dir=str(tmp_path),
+        restart_backoff_base=0.05,
+        resync_period=0.05,
+    )
+    with cluster:
+        uid = cluster.submit(_mnist_job(tmp_path, replicas=2, steps=10))
+        _wait_for_step(cluster, uid, 3)  # ≥1 checkpoint (every 2) durable
+        assert cluster.launcher.kill(f"{uid}/worker-1")  # the chaos event
+
+        status = cluster.wait(uid, timeout=600)
+        log0_all = cluster.logs(uid, "worker", 0)
+        assert status.phase == "Succeeded", f"log:\n{log0_all}"
+        assert status.restart_count == 1
+
+        # Attempt 1 must RESUME: its first logged step is after the restored
+        # checkpoint (>2 would also catch an off-by-one replay; >1 proves
+        # it did not start over).
+        log0_retry = cluster.logs(uid, "worker", 0, attempt=1)
+        retry_steps = [m["step"] for m in parse_stdout_metrics(log0_retry)]
+        assert retry_steps, f"no metrics in attempt-1 log:\n{log0_retry}"
+        assert retry_steps[0] > 1, retry_steps
+        assert retry_steps[-1] == 10
+        assert "final_loss=" in log0_retry
+
+
+@pytest.mark.slow
+def test_scale_down_resumes_on_smaller_mesh(tmp_path):
+    cluster = LocalCluster(
+        fleet=Fleet.homogeneous(2, "2x2"),
+        wiring=WiringConfig(platform="cpu_sim", devices_per_worker=4),
+        base_dir=str(tmp_path),
+        restart_backoff_base=0.05,
+        resync_period=0.05,
+    )
+    with cluster:
+        uid = cluster.submit(
+            _mnist_job(
+                tmp_path, replicas=2, steps=10,
+                elastic=ElasticPolicy(min_replicas=1, max_replicas=2),
+                name="mnist-elastic",
+            )
+        )
+        _wait_for_step(cluster, uid, 3)
+        assert cluster.scale(uid, 1) == 1
+
+        status = cluster.wait(uid, timeout=600)
+        log0 = cluster.logs(uid, "worker", 0)
+        assert status.phase == "Succeeded", f"log:\n{log0}"
+        assert cluster.get(uid).spec.replicas["worker"].replicas == 1
+
+        # world was 2x4=8 devices before the scale, 4 after — and the
+        # post-scale run resumed from checkpoint rather than replaying 0.
+        assert "4 local / 8 global" in log0
+        assert "4 local / 4 global" in log0
+        post = log0.split("4 local / 4 global", 1)[1]
+        post_steps = [m["step"] for m in parse_stdout_metrics(post)]
+        assert post_steps and post_steps[0] > 1, post_steps
+        assert post_steps[-1] == 10

@@ -1,0 +1,196 @@
+"""Observability plane: prom registry/exposition, heartbeats, profiler,
+JSON logs, ObsServer endpoints (SURVEY.md §5.1/§5.5 equivalents)."""
+
+import io
+import json
+import logging
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.obs import (
+    HeartbeatWriter,
+    JsonFormatter,
+    ObsServer,
+    Registry,
+    capture_trace,
+    heartbeat_path,
+    is_stale,
+    read_heartbeat,
+)
+
+
+# -- prom ----------------------------------------------------------------- #
+
+
+def test_counter_and_gauge_exposition():
+    reg = Registry()
+    c = reg.counter("req_total", "requests", labels=("code",))
+    c.labels(code="200").inc()
+    c.labels(code="200").inc(2)
+    c.labels(code="500").inc()
+    g = reg.gauge("temp", "temperature")
+    g.set(3.5)
+    g.inc()
+    text = reg.expose()
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{code="200"} 3' in text
+    assert 'req_total{code="500"} 1' in text
+    assert "# TYPE temp gauge" in text
+    assert "temp 4.5" in text
+
+
+def test_counter_rejects_negative_and_wrong_labels():
+    reg = Registry()
+    c = reg.counter("x_total", "x", labels=("a",))
+    with pytest.raises(ValueError):
+        c.labels(a="1").inc(-1)
+    with pytest.raises(ValueError):
+        c.labels(b="1")
+    with pytest.raises(ValueError):
+        c.inc()  # labeled metric needs .labels()
+
+
+def test_registry_rejects_type_conflicts_and_dedupes():
+    reg = Registry()
+    c1 = reg.counter("m", "m")
+    c2 = reg.counter("m", "m")
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        reg.gauge("m", "m")
+
+
+def test_histogram_buckets_cumulative():
+    reg = Registry()
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.expose()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 3' in text
+    assert 'lat_bucket{le="10"} 4' in text
+    assert 'lat_bucket{le="+Inf"} 5' in text
+    assert "lat_count 5" in text
+    assert "lat_sum 56.05" in text
+
+
+def test_histogram_timer():
+    reg = Registry()
+    h = reg.histogram("t", "t", buckets=(10.0,))
+    with h.time():
+        pass
+    assert "t_count 1" in reg.expose()
+
+
+# -- heartbeat ------------------------------------------------------------ #
+
+
+def test_heartbeat_roundtrip_and_staleness(tmp_path):
+    path = heartbeat_path(tmp_path, "worker", 0)
+    hb = HeartbeatWriter(path, interval=0.05, attempt=2)
+    with hb:
+        hb.beat(step=7)
+        beat = read_heartbeat(path)
+        assert beat is not None
+        assert beat.step == 7
+        assert beat.attempt == 2
+        assert not is_stale(path, timeout=5.0)
+        # beats from an older attempt don't count for the current one
+        assert not is_stale(path, timeout=0.0, min_attempt=3)
+    time.sleep(0.15)
+    assert is_stale(path, timeout=0.1)  # writer stopped → goes stale
+
+
+def test_heartbeat_background_thread_beats(tmp_path):
+    path = heartbeat_path(tmp_path, "worker", 1)
+    with HeartbeatWriter(path, interval=0.02):
+        time.sleep(0.1)
+        first = read_heartbeat(path).time
+        time.sleep(0.1)
+        assert read_heartbeat(path).time > first
+
+
+def test_missing_heartbeat_is_not_stale(tmp_path):
+    assert not is_stale(tmp_path / "nope.json", timeout=0.0)
+    assert read_heartbeat(tmp_path / "nope.json") is None
+
+
+def test_heartbeat_from_env(tmp_path, monkeypatch):
+    from kubeflow_tpu.orchestrator import envwire
+
+    monkeypatch.setenv(envwire.ENV_WORKDIR, str(tmp_path))
+    monkeypatch.setenv(envwire.ENV_REPLICA_TYPE, "worker")
+    monkeypatch.setenv(envwire.ENV_REPLICA_INDEX, "3")
+    monkeypatch.setenv(envwire.ENV_ATTEMPT, "1")
+    hb = HeartbeatWriter.from_env()
+    assert hb is not None
+    hb.beat()
+    beat = read_heartbeat(heartbeat_path(tmp_path, "worker", 3))
+    assert beat.attempt == 1
+
+
+# -- json logging --------------------------------------------------------- #
+
+
+def test_json_formatter_fields():
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    handler.setFormatter(JsonFormatter(static_fields={"svc": "test"}))
+    log = logging.getLogger("kft.test.json")
+    log.addHandler(handler)
+    log.setLevel(logging.INFO)
+    try:
+        log.info("hello %s", "world", extra={"fields": {"k": 1}})
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            log.exception("failed")
+    finally:
+        log.removeHandler(handler)
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert lines[0]["msg"] == "hello world"
+    assert lines[0]["svc"] == "test"
+    assert lines[0]["k"] == 1
+    assert lines[0]["level"] == "info"
+    assert "ValueError: boom" in lines[1]["exc"]
+
+
+# -- profiler + server ---------------------------------------------------- #
+
+
+def test_capture_trace_writes_events(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    logdir = tmp_path / "prof"
+    with capture_trace(logdir):
+        jax.block_until_ready(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+    files = list(logdir.rglob("*"))
+    assert any(f.suffix in (".pb", ".gz", ".json") or "trace" in f.name
+               for f in files if f.is_file()), files
+
+
+def test_obs_server_endpoints(tmp_path):
+    reg = Registry()
+    reg.counter("up", "up").inc()
+    with ObsServer(
+        registry=reg,
+        profile_logdir=tmp_path,
+        state_fn=lambda: {"jobs": 2},
+    ) as srv:
+        assert urllib.request.urlopen(srv.url + "/healthz").read() == b"ok"
+        metrics = urllib.request.urlopen(srv.url + "/metrics").read().decode()
+        assert "up 1" in metrics
+        state = json.loads(
+            urllib.request.urlopen(srv.url + "/debug/state").read()
+        )
+        assert state == {"jobs": 2}
+        req = urllib.request.Request(
+            srv.url + "/profile?seconds=0.1", method="POST"
+        )
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["seconds"] == 0.1
+        import pathlib
+
+        assert pathlib.Path(out["logdir"]).exists()
