@@ -1,0 +1,99 @@
+"""PyTorchJob MNIST worker — runs the REFERENCE's stack under OUR control
+plane (BASELINE config 1, exactly: DDP over the gloo CPU backend).
+
+Where ``kubeflow_tpu.examples.mnist`` is the TPU-native replacement, this
+worker is the compatibility proof: a torch ``DistributedDataParallel``
+training loop (the reference example's shape — SURVEY.md §2.1 "Examples"
+row, §3.1 hot loop) that rendezvouses purely from the env the JAXJob
+control plane wrote for kind=PyTorchJob (MASTER_ADDR/MASTER_PORT/RANK/
+WORLD_SIZE — kubeflow_tpu.orchestrator.kinds). A reference user's torch
+job therefore ports by swapping the manifest, not the training code.
+
+Synthetic class-prototype data (no dataset downloads in this image), CNN
+sized like the canonical mnist example, loss printed in the tuner-scrapable
+``key=value`` format.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--global-batch", type=int, default=64)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--log-every", type=int, default=5)
+    p.add_argument("--backend", type=str, default="gloo")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import torch
+    import torch.distributed as dist
+    import torch.nn as nn
+    from torch.nn.parallel import DistributedDataParallel
+
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    # MASTER_ADDR/MASTER_PORT are read from env by init_process_group.
+    dist.init_process_group(args.backend, rank=rank, world_size=world)
+    print(
+        f"process {rank}/{world}: torch {args.backend} process group up",
+        flush=True,
+    )
+
+    torch.manual_seed(args.seed)
+    model = nn.Sequential(
+        nn.Conv2d(1, 32, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Conv2d(32, 64, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Flatten(), nn.Linear(64 * 7 * 7, 128), nn.ReLU(),
+        nn.Linear(128, 10),
+    )
+    ddp = DistributedDataParallel(model)
+    opt = torch.optim.Adam(ddp.parameters(), lr=args.lr)
+    loss_fn = nn.CrossEntropyLoss()
+
+    # Same synthetic distribution as the JAX example: fixed class
+    # prototypes + noise, rank-sharded batches.
+    proto_rng = np.random.default_rng(args.seed)
+    protos = proto_rng.normal(size=(10, 28, 28)).astype("float32")
+    local_batch = args.global_batch // world
+
+    t0 = time.perf_counter()
+    for step in range(1, args.steps + 1):
+        rng = np.random.default_rng(args.seed + step * world + rank)
+        labels = rng.integers(0, 10, size=local_batch)
+        images = protos[labels] + 0.3 * rng.normal(
+            size=(local_batch, 28, 28)
+        ).astype("float32")
+        x = torch.from_numpy(images).unsqueeze(1)
+        y = torch.from_numpy(labels)
+
+        opt.zero_grad()
+        out = ddp(x)
+        loss = loss_fn(out, y)
+        loss.backward()  # ← DDP's bucketed gloo allreduce fires here
+        opt.step()
+
+        if rank == 0 and (step % args.log_every == 0 or step == args.steps):
+            acc = (out.argmax(dim=1) == y).float().mean().item()
+            sps = step / (time.perf_counter() - t0)
+            print(
+                f"step={step} loss={loss.item():.6g} accuracy={acc:.6g} "
+                f"steps_per_sec={sps:.6g}",
+                flush=True,
+            )
+
+    dist.barrier()
+    if rank == 0:
+        print(f"final_loss={loss.item():.6g}", flush=True)
+    dist.destroy_process_group()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

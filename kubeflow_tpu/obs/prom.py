@@ -251,12 +251,15 @@ class Registry:
         with self._lock:
             existing = self._metrics.get(metric.name)
             if existing is not None:
-                if type(existing) is not type(metric) or (
-                    existing.label_names != metric.label_names
+                if (
+                    type(existing) is not type(metric)
+                    or existing.label_names != metric.label_names
+                    or getattr(existing, "buckets", None)
+                    != getattr(metric, "buckets", None)
                 ):
                     raise ValueError(
                         f"metric {metric.name} re-registered with a "
-                        "different type or labels"
+                        "different type, labels, or buckets"
                     )
                 return existing
             self._metrics[metric.name] = metric

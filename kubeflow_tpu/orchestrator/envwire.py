@@ -61,11 +61,26 @@ def build_worker_env(
     wiring: WiringConfig,
     workdir: str,
     attempt: int,
+    service_ports: dict[str, int] | None = None,
     base_env: dict[str, str] | None = None,
 ) -> dict[str, str]:
     """Full child environment for one gang member."""
+    from kubeflow_tpu.orchestrator import kinds
+
     env = dict(os.environ if base_env is None else base_env)
     env.update(job.replicas[rtype].env)
+    # kind-specific rendezvous contract (MASTER_ADDR / TF_CONFIG / DMLC_* /
+    # hostfile / PADDLE_*) — the per-kind controllers' env wiring, unified.
+    env.update(
+        kinds.kind_env(
+            job,
+            rtype,
+            index,
+            host=wiring.coordinator_host,
+            service_ports=service_ports or {},
+            workdir=workdir,
+        )
+    )
 
     ranks = job.global_ranks()
     rank = ranks[(rtype, index)]

@@ -1,0 +1,344 @@
+"""Job-kind compatibility: the five reference CRDs on one reconciler.
+
+The reference ships five Go controllers (PyTorchJob/TFJob/MPIJob/
+XGBoostJob/PaddleJob) that all delegate to one common engine and differ
+only in (a) the CRD manifest shape and (b) the rendezvous env each kind's
+framework expects (SURVEY.md §2.1, §2.7). This module is both halves for
+the TPU control plane:
+
+- ``from_manifest`` / ``to_manifest``: K8s-style CRD manifests ⇄ JobSpec,
+  so reference job YAML translates 1:1 (SURVEY.md §5.6). Accelerator claims
+  map ``google.com/tpu`` + ``cloud.google.com/gke-tpu-topology`` (and, for
+  migration convenience, ``nvidia.com/gpu`` → chips).
+- ``kind_env``: per-kind rendezvous wiring — the ``SetClusterSpec`` /
+  ``setPodEnv`` / TF_CONFIG-builder / hostfile analogs (upstream
+  [training-operator] pkg/controller.v1/{pytorch/envvar,tensorflow}
+  — UNVERIFIED, SURVEY.md §0):
+
+  | kind       | env contract emitted                                     |
+  |------------|----------------------------------------------------------|
+  | JAXJob     | (none extra — the jax.distributed contract is universal) |
+  | PyTorchJob | MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK/LOCAL_RANK + PET_* |
+  | TFJob      | TF_CONFIG JSON {cluster:{type:[host:port…]},task:{type,index}} |
+  | MPIJob     | hostfile in the job workdir + OMPI_MCA_orte_default_hostfile |
+  | XGBoostJob | DMLC_TRACKER_URI/PORT, DMLC_TASK_ID, DMLC_NUM_WORKER     |
+  | PaddleJob  | PADDLE_TRAINER_ENDPOINTS/CURRENT_ENDPOINT/TRAINER_ID/NUM |
+
+Every kind ALSO gets the jax.distributed contract, so a payload may use
+either stack; torch (CPU) is present in this image, making PyTorchJob-on-
+gloo a genuinely runnable path (BASELINE config 1's exact backend).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Mapping
+
+from kubeflow_tpu.orchestrator.spec import (
+    CleanPodPolicy,
+    ElasticPolicy,
+    JobSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    TPURequest,
+)
+
+#: kind → the manifest key holding its replica specs
+REPLICA_SPEC_KEYS: dict[str, str] = {
+    "JAXJob": "jaxReplicaSpecs",
+    "PyTorchJob": "pytorchReplicaSpecs",
+    "TFJob": "tfReplicaSpecs",
+    "MPIJob": "mpiReplicaSpecs",
+    "XGBoostJob": "xgbReplicaSpecs",
+    "PaddleJob": "paddleReplicaSpecs",
+}
+KINDS = tuple(REPLICA_SPEC_KEYS)
+
+#: GKE accelerator label values → TPURequest.generation
+_ACCEL_GENERATIONS = {
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v4-podslice": "v4",
+    "tpu-v6e-slice": "v6e",
+}
+_GENERATION_ACCELS = {v: k for k, v in _ACCEL_GENERATIONS.items()}
+
+
+# --------------------------------------------------------------------- #
+# manifest → JobSpec
+# --------------------------------------------------------------------- #
+
+
+def from_manifest(manifest: Mapping[str, Any]) -> JobSpec:
+    """Translate a reference-style CRD manifest into a JobSpec."""
+    kind = manifest.get("kind", "JAXJob")
+    if kind not in REPLICA_SPEC_KEYS:
+        raise ValueError(f"unknown job kind {kind!r}; expected one of {KINDS}")
+    meta = manifest.get("metadata", {})
+    spec = manifest.get("spec", {})
+    rkey = REPLICA_SPEC_KEYS[kind]
+    replica_specs = spec.get(rkey) or spec.get("replicaSpecs")
+    if not replica_specs:
+        raise ValueError(f"manifest has no {rkey}")
+
+    replicas = {
+        rtype.lower(): _replica_from_manifest(rspec)
+        for rtype, rspec in replica_specs.items()
+    }
+    elastic = None
+    ep = spec.get("elasticPolicy")
+    if ep:
+        rtype = ep.get("replicaType", "worker").lower()
+        if rtype not in replicas:
+            # reference elastic always targets Worker; when a job has no
+            # 'worker' group, the scalable group is the non-coordinator one
+            # (last in rank order).
+            order = sorted(replicas, key=lambda n: n in ("master", "chief", "launcher"))
+            rtype = order[0]
+        elastic = ElasticPolicy(
+            replica_type=rtype,
+            min_replicas=int(ep.get("minReplicas", 1)),
+            max_replicas=(
+                int(ep["maxReplicas"]) if ep.get("maxReplicas") is not None else None
+            ),
+            heartbeat_timeout_seconds=ep.get("heartbeatTimeoutSeconds"),
+            heartbeat_grace_seconds=float(ep.get("heartbeatGraceSeconds", 30.0)),
+            progress_timeout_seconds=ep.get("progressTimeoutSeconds"),
+        )
+
+    job = JobSpec(
+        name=meta.get("name", "job"),
+        replicas=replicas,
+        run_policy=_run_policy_from_manifest(spec.get("runPolicy", {})),
+        elastic=elastic,
+        namespace=meta.get("namespace", "default"),
+        labels=dict(meta.get("labels", {})),
+        kind=kind,
+    )
+    if "uid" in meta:
+        job.uid = meta["uid"]
+    return job
+
+
+def _replica_from_manifest(rspec: Mapping[str, Any]) -> ReplicaSpec:
+    template = rspec.get("template", {})
+    pod = template.get("spec", {})
+    containers = pod.get("containers", [])
+    if not containers:
+        raise ValueError("replica template has no containers")
+    c = containers[0]
+    command = tuple(c.get("command", ())) + tuple(c.get("args", ()))
+    env = {e["name"]: str(e.get("value", "")) for e in c.get("env", ())}
+
+    limits = c.get("resources", {}).get("limits", {})
+    selector = pod.get("nodeSelector", {})
+    chips = int(limits.get("google.com/tpu", limits.get("nvidia.com/gpu", 0)))
+    topology = selector.get("cloud.google.com/gke-tpu-topology")
+    accel = selector.get("cloud.google.com/gke-tpu-accelerator", "")
+    generation = _ACCEL_GENERATIONS.get(accel, "v5e")
+
+    return ReplicaSpec(
+        replicas=int(rspec.get("replicas", 1)),
+        command=command,
+        env=env,
+        restart_policy=RestartPolicy(rspec.get("restartPolicy", "OnFailure")),
+        tpu=TPURequest(chips=chips, topology=topology, generation=generation),
+    )
+
+
+def _run_policy_from_manifest(rp: Mapping[str, Any]) -> RunPolicy:
+    sched = rp.get("schedulingPolicy", {}) or {}
+    return RunPolicy(
+        backoff_limit=int(rp.get("backoffLimit", 3)),
+        active_deadline_seconds=rp.get("activeDeadlineSeconds"),
+        ttl_seconds_after_finished=rp.get("ttlSecondsAfterFinished"),
+        clean_pod_policy=CleanPodPolicy(rp.get("cleanPodPolicy", "Running")),
+        scheduling=SchedulingPolicy(
+            gang=True,
+            min_available=sched.get("minAvailable"),
+            queue=sched.get("queue", "default"),
+            priority=int(sched.get("priorityValue", 0)),
+            timeout_seconds=sched.get("scheduleTimeoutSeconds"),
+        ),
+    )
+
+
+# --------------------------------------------------------------------- #
+# JobSpec → manifest (round-trip / export)
+# --------------------------------------------------------------------- #
+
+
+def to_manifest(job: JobSpec) -> dict:
+    rkey = REPLICA_SPEC_KEYS[job.kind]
+    replica_specs = {}
+    for rtype, r in job.replicas.items():
+        selector = {}
+        limits = {}
+        if r.tpu.chips:
+            limits["google.com/tpu"] = r.tpu.chips
+            selector["cloud.google.com/gke-tpu-accelerator"] = (
+                _GENERATION_ACCELS.get(r.tpu.generation, "tpu-v5-lite-podslice")
+            )
+        if r.tpu.topology:
+            selector["cloud.google.com/gke-tpu-topology"] = r.tpu.topology
+        container: dict[str, Any] = {
+            "name": job.kind.lower().replace("job", ""),
+            "command": list(r.command),
+            "env": [{"name": k, "value": v} for k, v in r.env.items()],
+        }
+        if limits:
+            container["resources"] = {"limits": limits}
+        pod: dict[str, Any] = {"containers": [container]}
+        if selector:
+            pod["nodeSelector"] = selector
+        replica_specs[rtype.capitalize()] = {
+            "replicas": r.replicas,
+            "restartPolicy": r.restart_policy.value,
+            "template": {"spec": pod},
+        }
+
+    rp = job.run_policy
+    manifest: dict[str, Any] = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": job.kind,
+        "metadata": {
+            "name": job.name,
+            "namespace": job.namespace,
+            "labels": dict(job.labels),
+            "uid": job.uid,
+        },
+        "spec": {
+            rkey: replica_specs,
+            "runPolicy": {
+                "backoffLimit": rp.backoff_limit,
+                "activeDeadlineSeconds": rp.active_deadline_seconds,
+                "ttlSecondsAfterFinished": rp.ttl_seconds_after_finished,
+                "cleanPodPolicy": rp.clean_pod_policy.value,
+                "schedulingPolicy": {
+                    "minAvailable": rp.scheduling.min_available,
+                    "queue": rp.scheduling.queue,
+                    "priorityValue": rp.scheduling.priority,
+                    "scheduleTimeoutSeconds": rp.scheduling.timeout_seconds,
+                },
+            },
+        },
+    }
+    if job.elastic is not None:
+        manifest["spec"]["elasticPolicy"] = {
+            "replicaType": job.elastic.replica_type.capitalize(),
+            "minReplicas": job.elastic.min_replicas,
+            "maxReplicas": job.elastic.max_replicas,
+            "heartbeatTimeoutSeconds": job.elastic.heartbeat_timeout_seconds,
+            "heartbeatGraceSeconds": job.elastic.heartbeat_grace_seconds,
+            "progressTimeoutSeconds": job.elastic.progress_timeout_seconds,
+        }
+    return manifest
+
+
+# --------------------------------------------------------------------- #
+# per-kind rendezvous env (the SetClusterSpec / TF_CONFIG analog)
+# --------------------------------------------------------------------- #
+
+
+def kind_env(
+    job: JobSpec,
+    rtype: str,
+    index: int,
+    *,
+    host: str,
+    service_ports: Mapping[str, int],
+    workdir: str,
+) -> dict[str, str]:
+    """Extra env for this worker per the job's kind. ``service_ports`` maps
+    ``"{rtype}-{index}"`` → this gang attempt's per-worker port."""
+    if job.kind == "JAXJob":
+        return {}  # the universal jax.distributed contract suffices
+
+    ranks = job.global_ranks()
+    rank = ranks[(rtype, index)]
+    world = job.total_replicas
+    # The rank-0 worker's dedicated service port doubles as the framework
+    # rendezvous port (c10d store / rabit tracker) — a real allocated port,
+    # never a guessed offset off the jax coordinator's.
+    rank0_type = job.replica_order()[0]
+    master_port = service_ports[f"{rank0_type}-0"]
+
+    if job.kind == "PyTorchJob":
+        return {
+            "MASTER_ADDR": host,
+            "MASTER_PORT": str(master_port),
+            "WORLD_SIZE": str(world),
+            "RANK": str(rank),
+            "LOCAL_RANK": "0",
+            # torchrun/elastic (PET = PyTorch Elastic Training) surface
+            "PET_NNODES": str(world),
+            "PET_NODE_RANK": str(rank),
+            "PET_NPROC_PER_NODE": "1",
+            "PET_MASTER_ADDR": host,
+            "PET_MASTER_PORT": str(master_port),
+        }
+
+    if job.kind == "TFJob":
+        cluster: dict[str, list[str]] = {}
+        for rt in job.replica_order():
+            cluster[rt] = [
+                f"{host}:{service_ports[f'{rt}-{i}']}"
+                for i in range(job.replicas[rt].replicas)
+            ]
+        tf_config = {
+            "cluster": cluster,
+            "task": {"type": rtype, "index": index},
+        }
+        return {"TF_CONFIG": json.dumps(tf_config)}
+
+    if job.kind == "MPIJob":
+        # Launcher-side hostfile, as the MPIJob controller's ConfigMap; in
+        # the local gang every slot is this host. Rewritten (atomically)
+        # every wiring pass: an elastic resize changes the slot count, so a
+        # keep-if-exists file would advertise the old world size.
+        hostfile = Path(workdir) / "hostfile"
+        lines = [
+            f"{host} slots=1"
+            for rt in job.replica_order()
+            if rt != "launcher"
+            for _ in range(job.replicas[rt].replicas)
+        ]
+        tmp = hostfile.with_suffix(f".tmp-{rtype}-{index}")
+        tmp.write_text("\n".join(lines) + "\n")
+        tmp.replace(hostfile)
+        return {
+            "OMPI_MCA_orte_default_hostfile": str(hostfile),
+            "OMPI_ALLOW_RUN_AS_ROOT": "1",
+            "OMPI_ALLOW_RUN_AS_ROOT_CONFIRM": "1",
+        }
+
+    if job.kind == "XGBoostJob":
+        # rabit tracker on the coordinator replica (SURVEY.md §2.1 "DMLC_*")
+        n_workers = sum(
+            r.replicas for rt, r in job.replicas.items() if rt != "master"
+        )
+        return {
+            "DMLC_TRACKER_URI": host,
+            "DMLC_TRACKER_PORT": str(master_port),
+            "DMLC_TASK_ID": str(rank),
+            "DMLC_NUM_WORKER": str(n_workers or world),
+            "DMLC_ROLE": "server" if rtype == "master" else "worker",
+        }
+
+    if job.kind == "PaddleJob":
+        endpoints = [
+            f"{host}:{service_ports[f'{rt}-{i}']}"
+            for rt in job.replica_order()
+            for i in range(job.replicas[rt].replicas)
+        ]
+        return {
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": f"{host}:{service_ports[f'{rtype}-{index}']}",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+        }
+
+    raise AssertionError(f"unhandled kind {job.kind!r}")  # guarded in JobSpec

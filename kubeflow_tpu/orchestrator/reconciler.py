@@ -57,6 +57,9 @@ class JobObject:
     spec: JobSpec
     status: JobStatus = dataclasses.field(default_factory=JobStatus)
     coordinator_port: int = 0
+    #: "{rtype}-{index}" → per-worker service port for this gang attempt
+    #: (TF_CONFIG cluster spec, torch MASTER_PORT, paddle endpoints).
+    service_ports: dict[str, int] = dataclasses.field(default_factory=dict)
     next_restart_at: float = 0.0
     deletion_requested: bool = False
     #: pending elastic resize target for the scalable group (None = none).
@@ -166,6 +169,10 @@ class JobController:
                 )
         if job.coordinator_port == 0:
             job.coordinator_port = envwire.free_port()
+            job.service_ports = {
+                f"{w.replica_type}-{w.index}": envwire.free_port()
+                for w in desired
+            }
             self.jobs.update(uid, job)
 
         if time.time() >= job.next_restart_at:
@@ -250,6 +257,7 @@ class JobController:
             w.replica_type,
             w.index,
             coordinator_port=job.coordinator_port,
+            service_ports=job.service_ports,
             wiring=self.wiring,
             workdir=str(self.launcher.workdir(spec.uid)),
             attempt=w.restarts,
@@ -294,9 +302,10 @@ class JobController:
         job.next_restart_at = time.time() + self.restart_backoff_base * (
             2 ** (status.restart_count - 1)
         )
-        # New coordinator port per attempt: the old rank-0 process may still
-        # hold the previous one while dying.
+        # New ports per attempt: the old processes may still hold the
+        # previous ones while dying.
         job.coordinator_port = envwire.free_port()
+        job.service_ports = {k: envwire.free_port() for k in job.service_ports}
         self.jobs.update(job.spec.uid, job)
 
         for w in ws:
@@ -374,7 +383,9 @@ class JobController:
             ).unlink(missing_ok=True)
         self.scheduler.cancel(uid)
         job.resize_to = None
-        job.coordinator_port = envwire.free_port()
+        # Force full rewiring at the new size on the next sync.
+        job.coordinator_port = 0
+        job.service_ports = {}
         self.jobs.update(uid, job)
 
     def _rank0_worker(

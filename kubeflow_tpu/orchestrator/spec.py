@@ -153,6 +153,10 @@ class ElasticPolicy:
     max_replicas: int | None = None
     heartbeat_timeout_seconds: float | None = None
     heartbeat_grace_seconds: float = 30.0
+    #: kill a worker whose heartbeat *step* hasn't advanced in this long —
+    #: catches a wedged main thread whose background beat thread still runs
+    #: (deadlocked collective). Budget for the longest expected XLA compile.
+    progress_timeout_seconds: float | None = None
 
     def __post_init__(self) -> None:
         if self.max_replicas is not None and self.min_replicas > self.max_replicas:
@@ -176,6 +180,7 @@ class ElasticPolicy:
             ),
             heartbeat_timeout_seconds=d.get("heartbeat_timeout_seconds"),
             heartbeat_grace_seconds=float(d.get("heartbeat_grace_seconds", 30.0)),
+            progress_timeout_seconds=d.get("progress_timeout_seconds"),
         )
 
 
@@ -253,8 +258,16 @@ class JobSpec:
     namespace: str = "default"
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
     uid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex[:12])
+    #: CRD kind this job translates (JAXJob | PyTorchJob | TFJob | MPIJob |
+    #: XGBoostJob | PaddleJob); selects the rendezvous env contract the
+    #: workers get (kubeflow_tpu.orchestrator.kinds).
+    kind: str = "JAXJob"
 
     def __post_init__(self) -> None:
+        from kubeflow_tpu.orchestrator.kinds import KINDS
+
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown kind {self.kind!r}; expected {KINDS}")
         if not self.replicas:
             raise ValueError("JobSpec needs at least one replica group")
         for rtype, spec in self.replicas.items():
@@ -317,6 +330,7 @@ class JobSpec:
             namespace=d.get("namespace", "default"),
             labels=dict(d.get("labels", {})),
             uid=d.get("uid", uuid.uuid4().hex[:12]),
+            kind=d.get("kind", "JAXJob"),
         )
 
     def to_dict(self) -> dict:
@@ -338,6 +352,7 @@ class JobSpec:
             "namespace": self.namespace,
             "labels": dict(self.labels),
             "uid": self.uid,
+            "kind": self.kind,
         }
 
 

@@ -8,13 +8,23 @@ remaining hole — a worker that is alive but wedged (deadlocked collective,
 stuck host IO, hung before ``initialize``) — is covered here, the liveness
 probe analog:
 
-every supervisor pass, for each Running worker of a job whose
-``ElasticPolicy.heartbeat_timeout_seconds`` is armed, read the worker's
-heartbeat file (``kubeflow_tpu.obs.heartbeat``). If the newest beat of the
-*current attempt* is older than the timeout — or the worker has produced no
-beat within the startup grace — SIGKILL it. The launcher observes exit 137,
-and the normal gang-restart + checkpoint-restore machinery does the rest;
-the supervisor never touches job state directly.
+every supervisor pass, for each Running worker of the *elastic replica
+group* of a job whose ``ElasticPolicy`` arms a timeout, read the worker's
+heartbeat file (``kubeflow_tpu.obs.heartbeat``). Kill on any of:
+
+- ``heartbeat_timeout_seconds``: newest beat of the current attempt is
+  older than the timeout (process gone sick without exiting);
+- startup grace expired with no beat at all (never came up);
+- ``progress_timeout_seconds``: beats keep arriving but the stamped *step*
+  has not advanced — the main thread is wedged (deadlocked collective)
+  while the writer's background thread keeps the file fresh. Beat age
+  alone cannot catch this; step progress can.
+
+The launcher observes exit 137, and the normal gang-restart +
+checkpoint-restore machinery does the rest; the supervisor never touches
+job state directly. Workers outside ``ElasticPolicy.replica_type`` are
+exempt — other groups (an MPI launcher, a custom master) may legitimately
+never beat.
 """
 
 from __future__ import annotations
@@ -53,6 +63,8 @@ class HeartbeatSupervisor:
         #: attempt 0, and without it the new process would inherit the old
         #: one's clock and be killed mid-startup.
         self._running_since: dict[tuple[str, int, int | None], float] = {}
+        #: same tag → (last observed heartbeat step, when it last advanced)
+        self._progress: dict[tuple[str, int, int | None], tuple[int, float]] = {}
 
     def check(self, now: float | None = None) -> list[str]:
         """One supervision pass; returns the keys it killed."""
@@ -61,22 +73,29 @@ class HeartbeatSupervisor:
         live: set[tuple[str, int, int | None]] = set()
         for uid, job in self.jobs.list():
             policy = job.spec.elastic
-            timeout = policy.heartbeat_timeout_seconds if policy else None
-            if timeout is None or job.status.finished:
+            if policy is None or job.status.finished:
+                continue
+            if (
+                policy.heartbeat_timeout_seconds is None
+                and policy.progress_timeout_seconds is None
+            ):
                 continue
             for _, w in self.workers.list(prefix=f"{uid}/"):
                 if w.phase is not WorkerPhase.RUNNING:
                     continue
+                if w.replica_type != policy.replica_type:
+                    continue  # only the elastic group is expected to beat
                 tag = (w.key, w.restarts, w.pid)
                 live.add(tag)
                 since = self._running_since.setdefault(tag, now)
-                if self._is_hung(job, w, since, timeout, now):
+                if self._is_hung(job, w, since, now):
                     if self.launcher.kill(w.key):
                         killed.append(w.key)
         # forget workers that restarted or went away
         for tag in list(self._running_since):
             if tag not in live:
                 del self._running_since[tag]
+                self._progress.pop(tag, None)
         return killed
 
     def _is_hung(
@@ -84,7 +103,6 @@ class HeartbeatSupervisor:
         job,
         w: WorkerStatus,
         running_since: float,
-        timeout: float,
         now: float,
     ) -> bool:
         policy = job.spec.elastic
@@ -102,11 +120,34 @@ class HeartbeatSupervisor:
                 KILLS.labels(reason="no_heartbeat").inc()
                 return True
             return False
-        if beat.age(now) > timeout:
+        timeout = policy.heartbeat_timeout_seconds
+        if timeout is not None and beat.age(now) > timeout:
             logger.warning(
                 "killing %s: heartbeat stale %.1fs (timeout %.1fs, step %d)",
                 w.key, beat.age(now), timeout, beat.step,
             )
             KILLS.labels(reason="stale_heartbeat").inc()
+            return True
+        return self._progress_stalled(policy, w, beat, now)
+
+    def _progress_stalled(
+        self, policy, w: WorkerStatus, beat: hb.Heartbeat, now: float
+    ) -> bool:
+        """Fresh beats but a frozen step counter ⇒ the main thread is
+        wedged while the writer's daemon thread keeps beating."""
+        p_timeout = policy.progress_timeout_seconds
+        if p_timeout is None:
+            return False
+        tag = (w.key, w.restarts, w.pid)
+        last = self._progress.get(tag)
+        if last is None or beat.step > last[0]:
+            self._progress[tag] = (beat.step, now)
+            return False
+        if now - last[1] > p_timeout:
+            logger.warning(
+                "killing %s: step stuck at %d for %.1fs (timeout %.1fs)",
+                w.key, beat.step, now - last[1], p_timeout,
+            )
+            KILLS.labels(reason="no_progress").inc()
             return True
         return False

@@ -179,6 +179,71 @@ def test_supervisor_kills_hung_worker_and_gang_recovers(cluster):
     assert status.restart_count == 1
 
 
+#: beats CONTINUOUSLY on a thread (like a live HeartbeatWriter) but never
+#: advances the step on attempt 0 — the wedged-main-thread signature.
+BEAT_BUT_STUCK = HANG_THEN_OK.replace(
+    'if attempt == 0:\n    time.sleep(120)',
+    '''if attempt == 0:
+    import threading
+    def pump():
+        while True:
+            beat(); time.sleep(0.05)
+    threading.Thread(target=pump, daemon=True).start()
+    time.sleep(120)''',
+)
+
+
+def test_supervisor_kills_on_progress_stall(cluster):
+    spec = JobSpec(
+        name="stuck-step",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=1,
+                command=(PY, "-c", BEAT_BUT_STUCK),
+                restart_policy=RestartPolicy.ON_FAILURE,
+            )
+        },
+        elastic=ElasticPolicy(
+            # beats stay fresh — only the progress watchdog can catch this
+            heartbeat_timeout_seconds=30.0,
+            heartbeat_grace_seconds=30.0,
+            progress_timeout_seconds=0.6,
+        ),
+    )
+    uid = cluster.submit(spec)
+    status = cluster.wait(uid, timeout=60)
+    assert status.phase == "Succeeded", [c.to_dict() for c in status.conditions]
+    assert status.restart_count == 1
+
+
+def test_supervisor_ignores_non_elastic_groups(cluster):
+    """A master that never beats must not be executed for silence — only
+    the elastic replica_type group is expected to heartbeat."""
+    spec = JobSpec(
+        name="quiet-master",
+        replicas={
+            "master": ReplicaSpec(
+                replicas=1, command=(PY, "-c", "import time; time.sleep(1.0)")
+            ),
+            "worker": ReplicaSpec(
+                replicas=1, command=(PY, "-c", HANG_THEN_OK),
+                restart_policy=RestartPolicy.ON_FAILURE,
+            ),
+        },
+        elastic=ElasticPolicy(
+            replica_type="worker",
+            heartbeat_timeout_seconds=0.4,
+            heartbeat_grace_seconds=0.1,  # would kill the master instantly
+        ),
+    )
+    uid = cluster.submit(spec)
+    status = cluster.wait(uid, timeout=60)
+    # Success proves the master was never kill-looped to BackoffLimit;
+    # restart_count proves the hung worker WAS caught.
+    assert status.phase == "Succeeded", [c.to_dict() for c in status.conditions]
+    assert status.restart_count >= 1
+
+
 def test_supervisor_respects_startup_grace(cluster, tmp_path):
     sup = cluster.supervisor
     spec = JobSpec(

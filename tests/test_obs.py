@@ -59,6 +59,10 @@ def test_registry_rejects_type_conflicts_and_dedupes():
     assert c1 is c2
     with pytest.raises(ValueError):
         reg.gauge("m", "m")
+    h1 = reg.histogram("h", "h", buckets=(1.0, 2.0))
+    assert reg.histogram("h", "h", buckets=(1.0, 2.0)) is h1
+    with pytest.raises(ValueError):  # silent bucket drift is a data bug
+        reg.histogram("h", "h", buckets=(5.0,))
 
 
 def test_histogram_buckets_cumulative():
