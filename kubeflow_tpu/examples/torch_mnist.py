@@ -61,6 +61,11 @@ def main(argv: list[str] | None = None) -> int:
     # prototypes + noise, rank-sharded batches.
     proto_rng = np.random.default_rng(args.seed)
     protos = proto_rng.normal(size=(10, 28, 28)).astype("float32")
+    if args.global_batch < world:
+        raise SystemExit(
+            f"--global-batch {args.global_batch} smaller than world size "
+            f"{world}: every rank needs at least one sample"
+        )
     local_batch = args.global_batch // world
 
     t0 = time.perf_counter()

@@ -24,6 +24,7 @@ from kubeflow_tpu.orchestrator.resources import Fleet
 from kubeflow_tpu.orchestrator.spec import JobSpec, JobStatus
 from kubeflow_tpu.orchestrator.store import ObjectStore
 from kubeflow_tpu.orchestrator.supervisor import HeartbeatSupervisor
+from kubeflow_tpu.orchestrator.webhooks import AdmissionChain
 
 logger = logging.getLogger(__name__)
 
@@ -44,6 +45,7 @@ class LocalCluster:
         base_dir: str | None = None,
         resync_period: float = 0.1,
         restart_backoff_base: float = 1.0,
+        admission: "AdmissionChain | None" = None,
     ):
         self.fleet = fleet or Fleet.single_host(chips=8)
         self.wiring = wiring or WiringConfig(platform="cpu_sim")
@@ -63,6 +65,7 @@ class LocalCluster:
         self.supervisor = HeartbeatSupervisor(
             self.jobs, self.workers, self.launcher
         )
+        self.admission = admission or AdmissionChain()
         self._resync = resync_period
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -124,6 +127,7 @@ class LocalCluster:
     # -- job API (what the SDK client calls) --------------------------- #
 
     def submit(self, spec: JobSpec) -> str:
+        spec = self.admission.admit(spec)
         self.jobs.create(spec.uid, JobObject(spec=spec))
         self._wake.set()
         return spec.uid

@@ -96,7 +96,9 @@ def from_manifest(manifest: Mapping[str, Any]) -> JobSpec:
             # reference elastic always targets Worker; when a job has no
             # 'worker' group, the scalable group is the non-coordinator one
             # (last in rank order).
-            order = sorted(replicas, key=lambda n: n in ("master", "chief", "launcher"))
+            from kubeflow_tpu.orchestrator.spec import COORDINATOR_TYPES
+
+            order = sorted(replicas, key=lambda n: n in COORDINATOR_TYPES)
             rtype = order[0]
         elastic = ElasticPolicy(
             replica_type=rtype,
@@ -107,6 +109,11 @@ def from_manifest(manifest: Mapping[str, Any]) -> JobSpec:
             heartbeat_timeout_seconds=ep.get("heartbeatTimeoutSeconds"),
             heartbeat_grace_seconds=float(ep.get("heartbeatGraceSeconds", 30.0)),
             progress_timeout_seconds=ep.get("progressTimeoutSeconds"),
+            supervised_replica_types=(
+                tuple(t.lower() for t in ep["supervisedReplicaTypes"])
+                if ep.get("supervisedReplicaTypes") is not None
+                else None
+            ),
         )
 
     job = JobSpec(
@@ -234,6 +241,11 @@ def to_manifest(job: JobSpec) -> dict:
             "heartbeatTimeoutSeconds": job.elastic.heartbeat_timeout_seconds,
             "heartbeatGraceSeconds": job.elastic.heartbeat_grace_seconds,
             "progressTimeoutSeconds": job.elastic.progress_timeout_seconds,
+            "supervisedReplicaTypes": (
+                [t.capitalize() for t in job.elastic.supervised_replica_types]
+                if job.elastic.supervised_replica_types is not None
+                else None
+            ),
         }
     return manifest
 

@@ -157,6 +157,11 @@ class ElasticPolicy:
     #: catches a wedged main thread whose background beat thread still runs
     #: (deadlocked collective). Budget for the longest expected XLA compile.
     progress_timeout_seconds: float | None = None
+    #: replica groups the heartbeat supervisor watches. None → just the
+    #: elastic group. Include the coordinator group ("master") when its
+    #: payload is a trainer that beats (PyTorchJob-style); leave out groups
+    #: that legitimately never beat (an MPI launcher).
+    supervised_replica_types: tuple[str, ...] | None = None
 
     def __post_init__(self) -> None:
         if self.max_replicas is not None and self.min_replicas > self.max_replicas:
@@ -164,6 +169,11 @@ class ElasticPolicy:
                 f"min_replicas {self.min_replicas} > max_replicas "
                 f"{self.max_replicas}"
             )
+
+    def supervised_types(self) -> tuple[str, ...]:
+        if self.supervised_replica_types is not None:
+            return self.supervised_replica_types
+        return (self.replica_type,)
 
     def clamp(self, replicas: int) -> int:
         lo = max(1, self.min_replicas)
@@ -181,6 +191,11 @@ class ElasticPolicy:
             heartbeat_timeout_seconds=d.get("heartbeat_timeout_seconds"),
             heartbeat_grace_seconds=float(d.get("heartbeat_grace_seconds", 30.0)),
             progress_timeout_seconds=d.get("progress_timeout_seconds"),
+            supervised_replica_types=(
+                tuple(d["supervised_replica_types"])
+                if d.get("supervised_replica_types") is not None
+                else None
+            ),
         )
 
 

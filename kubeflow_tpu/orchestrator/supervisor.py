@@ -22,9 +22,10 @@ heartbeat file (``kubeflow_tpu.obs.heartbeat``). Kill on any of:
 
 The launcher observes exit 137, and the normal gang-restart +
 checkpoint-restore machinery does the rest; the supervisor never touches
-job state directly. Workers outside ``ElasticPolicy.replica_type`` are
-exempt — other groups (an MPI launcher, a custom master) may legitimately
-never beat.
+job state directly. Only groups in ``ElasticPolicy.supervised_types()``
+are watched (default: the elastic group) — other groups (an MPI launcher)
+may legitimately never beat; add "master" there when the coordinator is a
+trainer that beats (PyTorchJob-style).
 """
 
 from __future__ import annotations
@@ -83,8 +84,8 @@ class HeartbeatSupervisor:
             for _, w in self.workers.list(prefix=f"{uid}/"):
                 if w.phase is not WorkerPhase.RUNNING:
                     continue
-                if w.replica_type != policy.replica_type:
-                    continue  # only the elastic group is expected to beat
+                if w.replica_type not in policy.supervised_types():
+                    continue  # only supervised groups are expected to beat
                 tag = (w.key, w.restarts, w.pid)
                 live.add(tag)
                 since = self._running_since.setdefault(tag, now)

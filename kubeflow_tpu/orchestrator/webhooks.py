@@ -1,0 +1,64 @@
+"""Admission webhooks: mutating + validating hooks on job submission.
+
+The reference guards its CRDs with validating admission webhooks per kind
+(SURVEY.md §2.1 "Webhooks"; upstream analog [training-operator]
+pkg/webhooks/ — UNVERIFIED, SURVEY.md §0) and mutates pods with the
+PodDefaults webhook (§2.5). In the clusterless control plane the same
+contract is a hook chain run inside ``LocalCluster.submit``: mutators first
+(in registration order, each returning a possibly-new JobSpec), then
+validators (raise ``AdmissionError`` to reject). Platform policies —
+quotas, pod defaults — plug in here rather than patching the reconciler.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from kubeflow_tpu.orchestrator.spec import JobSpec
+
+Mutator = Callable[[JobSpec], JobSpec]
+Validator = Callable[[JobSpec], None]
+
+
+class AdmissionError(ValueError):
+    """Job rejected at admission; the message is the user-facing reason."""
+
+
+class AdmissionChain:
+    def __init__(
+        self,
+        mutators: list[Mutator] | None = None,
+        validators: list[Validator] | None = None,
+    ):
+        self.mutators: list[Mutator] = list(mutators or ())
+        self.validators: list[Validator] = [validate_scheduling]
+        self.validators.extend(validators or ())
+
+    def add_mutator(self, m: Mutator) -> None:
+        self.mutators.append(m)
+
+    def add_validator(self, v: Validator) -> None:
+        self.validators.append(v)
+
+    def admit(self, spec: JobSpec) -> JobSpec:
+        for m in self.mutators:
+            out = m(spec)
+            if out is not None:
+                spec = out
+        for v in self.validators:
+            v(spec)
+        return spec
+
+
+def validate_scheduling(spec: JobSpec) -> None:
+    """Built-in sanity the reference webhooks enforce: gang minAvailable
+    can't exceed the replica total."""
+    sched = spec.run_policy.scheduling
+    if (
+        sched.min_available is not None
+        and sched.min_available > spec.total_replicas
+    ):
+        raise AdmissionError(
+            f"schedulingPolicy.minAvailable {sched.min_available} exceeds "
+            f"total replicas {spec.total_replicas}"
+        )

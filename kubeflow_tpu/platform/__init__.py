@@ -1,0 +1,41 @@
+"""Platform plane: multi-tenancy, workspaces, defaults, dashboard.
+
+Minimal TPU-native equivalents of the reference's platform components
+(SURVEY.md §2.5): Profile controller (namespace + quota + access), Notebook
+controller (interactive jobs with idle culling), TensorBoard controller,
+PodDefaults admission mutator, and the central-dashboard aggregation API.
+"""
+
+from kubeflow_tpu.platform.dashboard import DashboardServer
+from kubeflow_tpu.platform.notebooks import (
+    NotebookController,
+    NotebookSpec,
+    NotebookStatus,
+)
+from kubeflow_tpu.platform.poddefaults import PodDefault
+from kubeflow_tpu.platform.profiles import (
+    Profile,
+    ProfileController,
+    ResourceQuota,
+    job_chips,
+)
+from kubeflow_tpu.platform.tensorboards import (
+    TensorboardController,
+    TensorboardSpec,
+    TensorboardStatus,
+)
+
+__all__ = [
+    "DashboardServer",
+    "NotebookController",
+    "NotebookSpec",
+    "NotebookStatus",
+    "PodDefault",
+    "Profile",
+    "ProfileController",
+    "ResourceQuota",
+    "TensorboardController",
+    "TensorboardSpec",
+    "TensorboardStatus",
+    "job_chips",
+]

@@ -1,0 +1,213 @@
+"""Central dashboard: one aggregated status API over every plane.
+
+The reference's central dashboard is a web shell aggregating the component
+UIs (SURVEY.md §2.5). The TPU control plane's equivalent is the data half:
+a JSON API (aiohttp on a daemon thread, the serving plane's stack) that
+aggregates jobs, profiles/quotas, notebooks, and tensorboards so any
+frontend — or ``curl`` — can see the whole platform at once.
+
+- ``GET /api/summary``      → counts per plane + fleet snapshot
+- ``GET /api/jobs``         → job list (phase, kind, replicas, restarts)
+- ``GET /api/profiles``     → profiles with live quota usage
+- ``GET /api/notebooks``    → notebook phases + idle times
+- ``GET /api/tensorboards`` → board phases + urls
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+from kubeflow_tpu.orchestrator.cluster import LocalCluster
+from kubeflow_tpu.platform.notebooks import NotebookController
+from kubeflow_tpu.platform.profiles import ProfileController, job_chips
+from kubeflow_tpu.platform.tensorboards import TensorboardController
+
+
+class DashboardServer:
+    def __init__(
+        self,
+        cluster: LocalCluster,
+        *,
+        profiles: ProfileController | None = None,
+        notebooks: NotebookController | None = None,
+        tensorboards: TensorboardController | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.cluster = cluster
+        self.profiles = profiles
+        self.notebooks = notebooks
+        self.tensorboards = tensorboards
+        self.host = host
+        self.port = port
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._runner = None
+        self._started = threading.Event()
+
+    # -- views ---------------------------------------------------------- #
+
+    def jobs_view(self) -> list[dict]:
+        out = []
+        for uid, job in self.cluster.jobs.list():
+            out.append(
+                {
+                    "uid": uid,
+                    "name": job.spec.name,
+                    "namespace": job.spec.namespace,
+                    "kind": job.spec.kind,
+                    "phase": job.status.phase,
+                    "replicas": {
+                        rt: r.replicas for rt, r in job.spec.replicas.items()
+                    },
+                    "chips": job_chips(job.spec),
+                    "restarts": job.status.restart_count,
+                }
+            )
+        return out
+
+    def profiles_view(self) -> list[dict]:
+        if self.profiles is None:
+            return []
+        out = []
+        for p in self.profiles.list():
+            usage = self.profiles.usage(p.name)
+            out.append(
+                {
+                    "name": p.name,
+                    "owner": p.owner,
+                    "quota": {
+                        "max_chips": p.quota.max_chips,
+                        "max_jobs": p.quota.max_jobs,
+                    },
+                    "usage": usage,
+                }
+            )
+        return out
+
+    def notebooks_view(self) -> list[dict]:
+        if self.notebooks is None:
+            return []
+        self.notebooks.reconcile()
+        out = []
+        for (ns, name), (spec, status) in self.notebooks._notebooks.items():
+            out.append(
+                {
+                    "name": name,
+                    "namespace": ns,
+                    "phase": status.phase,
+                    "idle_seconds": round(time.time() - status.last_activity, 1),
+                }
+            )
+        return out
+
+    def tensorboards_view(self) -> list[dict]:
+        if self.tensorboards is None:
+            return []
+        out = []
+        for (ns, name), (spec, status) in self.tensorboards._boards.items():
+            st = self.tensorboards.get(name, ns)
+            out.append(
+                {
+                    "name": name,
+                    "namespace": ns,
+                    "phase": st.phase,
+                    "url": st.url,
+                    "logdir": spec.logdir,
+                }
+            )
+        return out
+
+    def summary_view(self) -> dict:
+        jobs = self.jobs_view()
+        phases: dict[str, int] = {}
+        for j in jobs:
+            phases[j["phase"]] = phases.get(j["phase"], 0) + 1
+        return {
+            "jobs": {"total": len(jobs), "by_phase": phases},
+            "profiles": len(self.profiles_view()),
+            "notebooks": len(self.notebooks_view()),
+            "tensorboards": len(self.tensorboards_view()),
+            "fleet": {
+                "slices": len(self.cluster.fleet.snapshot()),
+                "total_chips": self.cluster.fleet.total_chips(),
+                "free_chips": self.cluster.fleet.free_chips(),
+            },
+        }
+
+    # -- server --------------------------------------------------------- #
+
+    def _make_app(self):
+        from aiohttp import web
+
+        def handler(fn):
+            async def h(request):
+                return web.Response(
+                    text=json.dumps(fn(), default=str),
+                    content_type="application/json",
+                )
+
+            return h
+
+        app = web.Application()
+        app.router.add_get("/api/summary", handler(self.summary_view))
+        app.router.add_get("/api/jobs", handler(self.jobs_view))
+        app.router.add_get("/api/profiles", handler(self.profiles_view))
+        app.router.add_get("/api/notebooks", handler(self.notebooks_view))
+        app.router.add_get("/api/tensorboards", handler(self.tensorboards_view))
+        return app
+
+    def start(self) -> "DashboardServer":
+        if self._thread is not None:
+            return self
+
+        def run():
+            from aiohttp import web
+
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def serve():
+                runner = web.AppRunner(self._make_app())
+                await runner.setup()
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+                self._runner = runner
+                self.port = runner.addresses[0][1]
+                self._started.set()
+
+            loop.run_until_complete(serve())
+            loop.run_forever()
+            loop.run_until_complete(self._runner.cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="kft-dashboard"
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("dashboard failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._loop = None
+        self._started.clear()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def __enter__(self) -> "DashboardServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,174 @@
+"""Lightweight training-log viewer — the TensorBoard payload that works.
+
+This image's ``tensorboard.main`` CLI cannot start (its ``pkg_resources``
+import is gone on py3.12), so the TensorBoard controller's default payload
+is this first-party server instead: it serves every run under a logdir over
+HTTP, reading BOTH metric formats the framework writes (SURVEY.md §5.5) —
+``metrics.jsonl`` from ``kubeflow_tpu.train.metrics.MetricWriter`` and
+TFEvents files (via tensorboard's event_accumulator, which still imports
+cleanly) — plus a listing of ``jax.profiler`` trace captures.
+
+- ``GET /``                   → minimal HTML index of runs
+- ``GET /api/runs``           → run names (dirs holding metrics/events)
+- ``GET /api/scalars?run=X``  → {metric: [[step, wall_time, value], ...]}
+- ``GET /api/profiles``       → captured profile trace directories
+- ``GET /healthz``            → liveness
+
+Run: ``python -m kubeflow_tpu.platform.logserver --logdir DIR --port N``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+
+def find_runs(logdir: Path, max_depth: int = 4) -> list[str]:
+    """Directories (relative to logdir; '.' = root) containing scalar data."""
+    runs: set[str] = set()
+
+    def scan(d: Path, depth: int) -> None:
+        try:
+            entries = list(d.iterdir())
+        except OSError:
+            return
+        has_data = any(
+            e.name == "metrics.jsonl" or e.name.startswith("events.out.tfevents")
+            for e in entries
+            if e.is_file()
+        )
+        if has_data:
+            runs.add(str(d.relative_to(logdir)) or ".")
+        if depth < max_depth:
+            for e in entries:
+                if e.is_dir():
+                    scan(e, depth + 1)
+
+    scan(logdir, 0)
+    return sorted(runs)
+
+
+def read_scalars(run_dir: Path) -> dict[str, list[list[float]]]:
+    """Merged scalar streams: metric name → [[step, wall_time, value]…]."""
+    out: dict[str, list[list[float]]] = {}
+
+    jsonl = run_dir / "metrics.jsonl"
+    if jsonl.exists():
+        for line in jsonl.read_text().splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            step = rec.get("step")
+            if not isinstance(step, (int, float)):
+                continue  # summary/partial records carry no step
+            t = rec.get("time", 0.0)
+            for k, v in rec.items():
+                if k in ("step", "time") or not isinstance(v, (int, float)):
+                    continue
+                out.setdefault(k, []).append([float(step), float(t), float(v)])
+
+    if any(f.name.startswith("events.out.tfevents") for f in run_dir.iterdir()):
+        try:
+            from tensorboard.backend.event_processing.event_accumulator import (
+                EventAccumulator,
+            )
+
+            acc = EventAccumulator(str(run_dir))
+            acc.Reload()
+            for tag in acc.Tags().get("scalars", ()):
+                out.setdefault(tag, []).extend(
+                    [[float(e.step), float(e.wall_time), float(e.value)]
+                     for e in acc.Scalars(tag)]
+                )
+        except Exception:  # noqa: BLE001 — events are best-effort extra
+            pass
+
+    for series in out.values():
+        series.sort(key=lambda rec: rec[0])
+    return out
+
+
+def find_profiles(logdir: Path) -> list[str]:
+    """jax.profiler capture dirs (the plugins/profile layout)."""
+    return sorted(
+        str(p.parent.relative_to(logdir))
+        for p in logdir.rglob("plugins/profile")
+        if p.is_dir()
+    )
+
+
+_INDEX_HTML = """<!doctype html>
+<title>kubeflow-tpu logs</title>
+<h1>kubeflow-tpu log server</h1>
+<p>logdir: <code>{logdir}</code></p>
+<h2>runs</h2>
+<ul>{runs}</ul>
+<h2>profile captures</h2>
+<ul>{profiles}</ul>
+"""
+
+
+def make_app(logdir: Path):
+    from aiohttp import web
+
+    async def index(request):
+        runs = "".join(
+            f'<li><a href="/api/scalars?run={r}">{r}</a></li>'
+            for r in find_runs(logdir)
+        )
+        profiles = "".join(f"<li>{p}</li>" for p in find_profiles(logdir))
+        return web.Response(
+            text=_INDEX_HTML.format(
+                logdir=logdir, runs=runs or "<li>(none)</li>",
+                profiles=profiles or "<li>(none)</li>",
+            ),
+            content_type="text/html",
+        )
+
+    async def runs(request):
+        return web.json_response(find_runs(logdir))
+
+    async def scalars(request):
+        run = request.query.get("run", ".")
+        run_dir = (logdir / run).resolve()
+        if not run_dir.is_relative_to(logdir.resolve()):
+            return web.json_response({"error": "run escapes logdir"}, status=400)
+        if not run_dir.is_dir():
+            return web.json_response({"error": f"no run {run!r}"}, status=404)
+        return web.json_response(read_scalars(run_dir))
+
+    async def profiles(request):
+        return web.json_response(find_profiles(logdir))
+
+    async def healthz(request):
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/api/runs", runs)
+    app.router.add_get("/api/scalars", scalars)
+    app.router.add_get("/api/profiles", profiles)
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
+def main(argv: list[str] | None = None) -> int:
+    from aiohttp import web
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--logdir", required=True)
+    p.add_argument("--port", type=int, default=6006)
+    p.add_argument("--host", default="127.0.0.1")
+    args = p.parse_args(argv)
+    logdir = Path(args.logdir)
+    logdir.mkdir(parents=True, exist_ok=True)
+    web.run_app(
+        make_app(logdir), host=args.host, port=args.port, print=None
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

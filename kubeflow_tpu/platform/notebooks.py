@@ -1,0 +1,154 @@
+"""Notebook controller: interactive workspaces with idle culling.
+
+The reference's notebook controller reconciles a ``Notebook`` CR into a
+StatefulSet + Service running Jupyter/VSCode, and its culling option stops
+idle servers (SURVEY.md §2.5; upstream analog [kubeflow/kubeflow]
+components/notebook-controller/ — UNVERIFIED, SURVEY.md §0). The TPU
+control plane maps a notebook to a single-replica, restart-Always JAXJob —
+an interactive process gang member with chips if requested — plus the
+culling loop: activity is reported via ``touch()`` (the web-app "last
+activity" probe analog) or the process's own heartbeat file, and a
+notebook idle past ``culling_idle_seconds`` has its job deleted. ``wake()``
+resubmits a culled notebook — scale-to-zero semantics for workspaces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from kubeflow_tpu.obs import heartbeat as hb
+from kubeflow_tpu.orchestrator.cluster import LocalCluster
+from kubeflow_tpu.orchestrator.spec import (
+    JobSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    TPURequest,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class NotebookSpec:
+    name: str
+    command: tuple[str, ...]
+    namespace: str = "default"
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    tpu: TPURequest = dataclasses.field(default_factory=TPURequest)
+    #: None disables culling
+    culling_idle_seconds: float | None = None
+
+
+@dataclasses.dataclass
+class NotebookStatus:
+    phase: str = "Pending"  # Pending | Running | Culled | Failed
+    job_uid: str | None = None
+    last_activity: float = dataclasses.field(default_factory=time.time)
+    culled_at: float | None = None
+
+
+class NotebookController:
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+        self._notebooks: dict[tuple[str, str], tuple[NotebookSpec, NotebookStatus]] = {}
+
+    # -- CRUD ----------------------------------------------------------- #
+
+    def create(self, spec: NotebookSpec) -> NotebookStatus:
+        key = (spec.namespace, spec.name)
+        if key in self._notebooks:
+            raise ValueError(f"notebook {spec.name!r} already exists")
+        status = NotebookStatus()
+        self._notebooks[key] = (spec, status)
+        self._start(spec, status)
+        return status
+
+    def get(self, name: str, namespace: str = "default") -> NotebookStatus:
+        self.reconcile()
+        return self._notebooks[(namespace, name)][1]
+
+    def list(self, namespace: str = "default") -> list[NotebookSpec]:
+        return [s for (ns, _), (s, _) in self._notebooks.items() if ns == namespace]
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        entry = self._notebooks.pop((namespace, name), None)
+        if entry and entry[1].job_uid:
+            self.cluster.delete(entry[1].job_uid)
+
+    # -- activity + culling --------------------------------------------- #
+
+    def touch(self, name: str, namespace: str = "default") -> None:
+        """Record user activity (the web app's probe analog)."""
+        self._notebooks[(namespace, name)][1].last_activity = time.time()
+
+    def wake(self, name: str, namespace: str = "default") -> NotebookStatus:
+        """Re-start a culled notebook."""
+        spec, status = self._notebooks[(namespace, name)]
+        if status.phase != "Culled":
+            return status
+        status.last_activity = time.time()
+        status.culled_at = None
+        self._start(spec, status)
+        return status
+
+    def reconcile(self, now: float | None = None) -> None:
+        """Refresh phases; cull notebooks idle past their deadline."""
+        now = time.time() if now is None else now
+        for (ns, name), (spec, status) in self._notebooks.items():
+            if status.phase == "Culled" or status.job_uid is None:
+                continue
+            job = self.cluster.get(status.job_uid)
+            if job is None:
+                status.phase = "Failed"
+                continue
+            phase = job.status.phase
+            status.phase = {
+                "Running": "Running",
+                "Failed": "Failed",
+            }.get(phase, "Pending" if not job.status.finished else "Failed")
+
+            # activity: explicit touches OR the process's own heartbeat
+            beat = hb.read_heartbeat(
+                hb.heartbeat_path(
+                    self.cluster.launcher.workdir(status.job_uid), "notebook", 0
+                )
+            )
+            if beat is not None:
+                status.last_activity = max(status.last_activity, beat.time)
+
+            idle = spec.culling_idle_seconds
+            if (
+                idle is not None
+                and status.phase == "Running"
+                and now - status.last_activity > idle
+            ):
+                self.cluster.delete(status.job_uid)
+                status.phase = "Culled"
+                status.culled_at = now
+                status.job_uid = None
+
+    # ------------------------------------------------------------------ #
+
+    def _start(self, spec: NotebookSpec, status: NotebookStatus) -> None:
+        job = JobSpec(
+            name=f"notebook-{spec.name}",
+            namespace=spec.namespace,
+            labels={"kubeflow-tpu/notebook": spec.name},
+            replicas={
+                "notebook": ReplicaSpec(
+                    replicas=1,
+                    command=spec.command,
+                    env=dict(spec.env),
+                    restart_policy=RestartPolicy.ALWAYS,  # workspaces respawn
+                    tpu=spec.tpu,
+                )
+            },
+            # interactive: effectively unbounded restarts, no TTL surprise
+            run_policy=RunPolicy(
+                backoff_limit=1_000_000,
+                scheduling=SchedulingPolicy(gang=True),
+            ),
+        )
+        status.job_uid = self.cluster.submit(job)
+        status.phase = "Pending"

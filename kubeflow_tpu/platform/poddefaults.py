@@ -1,0 +1,44 @@
+"""PodDefaults: label-selected defaults injected at admission.
+
+The reference's admission-webhook component injects secrets/env/tolerations
+into pods whose labels match a ``PodDefault`` selector (SURVEY.md §2.5;
+upstream analog [kubeflow/kubeflow] components/admission-webhook/ —
+UNVERIFIED, SURVEY.md §0). Here a ``PodDefault`` is a mutator on the
+admission chain: jobs whose labels match the selector get env/labels merged
+into every replica — explicit values on the job always win.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kubeflow_tpu.orchestrator.spec import JobSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class PodDefault:
+    name: str
+    #: all selector pairs must be present in the job's labels
+    selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def matches(self, spec: JobSpec) -> bool:
+        return all(spec.labels.get(k) == v for k, v in self.selector.items())
+
+    def __call__(self, spec: JobSpec) -> JobSpec:
+        """Mutator: merge defaults under the job's own settings. Pure — the
+        caller's spec object is never modified (a retried submit must not
+        see a silently altered spec)."""
+        if not self.matches(spec):
+            return spec
+        replicas = {}
+        for rtype, r in spec.replicas.items():
+            merged = {**self.env, **r.env}  # job env wins
+            replicas[rtype] = (
+                dataclasses.replace(r, env=merged) if merged != dict(r.env) else r
+            )
+        labels = dict(spec.labels)
+        for k, v in self.labels.items():
+            labels.setdefault(k, v)
+        return dataclasses.replace(spec, replicas=replicas, labels=labels)

@@ -1,0 +1,112 @@
+"""Profiles: multi-tenancy — namespaces, access rules, resource quotas.
+
+The reference's profile controller turns a ``Profile`` CR into a namespace
++ RBAC + Istio authz + resource quotas (SURVEY.md §2.5; upstream analog
+[kubeflow/kubeflow] components/profile-controller/ — UNVERIFIED, SURVEY.md
+§0). The TPU control plane keeps the same contract without a cluster: a
+profile OWNS a namespace, lists who may act in it, and carries a chip/job
+quota enforced at admission time — the `google.com/tpu` ResourceQuota
+analog, counted against live (non-finished) jobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from kubeflow_tpu.orchestrator.cluster import LocalCluster
+from kubeflow_tpu.orchestrator.spec import JobSpec
+from kubeflow_tpu.orchestrator.webhooks import AdmissionError
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceQuota:
+    """Per-namespace ceilings; None = unlimited."""
+
+    max_chips: int | None = None
+    max_jobs: int | None = None
+
+
+@dataclasses.dataclass
+class Profile:
+    name: str  # doubles as the namespace, as in the reference
+    owner: str
+    contributors: list[str] = dataclasses.field(default_factory=list)
+    quota: ResourceQuota = dataclasses.field(default_factory=ResourceQuota)
+    created: float = dataclasses.field(default_factory=time.time)
+
+    def can_act(self, user: str) -> bool:
+        return user == self.owner or user in self.contributors
+
+
+def job_chips(spec: JobSpec) -> int:
+    return sum(r.replicas * r.tpu.chips for r in spec.replicas.values())
+
+
+class ProfileController:
+    """Holds profiles and enforces their quotas on the cluster's jobs.
+
+    Register with ``install()``; admission then rejects any job whose
+    namespace has a profile and would exceed its quota. Namespaces without
+    a profile are unmanaged (admitted freely) unless ``strict``.
+    """
+
+    def __init__(self, cluster: LocalCluster, *, strict: bool = False):
+        self.cluster = cluster
+        self.strict = strict
+        self._profiles: dict[str, Profile] = {}
+
+    # -- CRUD ----------------------------------------------------------- #
+
+    def create(self, profile: Profile) -> Profile:
+        if profile.name in self._profiles:
+            raise ValueError(f"profile {profile.name!r} already exists")
+        self._profiles[profile.name] = profile
+        return profile
+
+    def get(self, name: str) -> Profile | None:
+        return self._profiles.get(name)
+
+    def list(self) -> list[Profile]:
+        return list(self._profiles.values())
+
+    def delete(self, name: str) -> None:
+        self._profiles.pop(name, None)
+
+    # -- enforcement ---------------------------------------------------- #
+
+    def install(self) -> None:
+        self.cluster.admission.add_validator(self.validate)
+
+    def usage(self, namespace: str) -> dict[str, int]:
+        """Live (non-finished) chips and jobs in the namespace."""
+        chips = jobs = 0
+        for _, job in self.cluster.jobs.list():
+            if job.spec.namespace != namespace or job.status.finished:
+                continue
+            jobs += 1
+            chips += job_chips(job.spec)
+        return {"chips": chips, "jobs": jobs}
+
+    def validate(self, spec: JobSpec) -> None:
+        profile = self._profiles.get(spec.namespace)
+        if profile is None:
+            if self.strict:
+                raise AdmissionError(
+                    f"namespace {spec.namespace!r} has no profile "
+                    "(strict multi-tenancy)"
+                )
+            return
+        used = self.usage(spec.namespace)
+        q = profile.quota
+        want = job_chips(spec)
+        if q.max_chips is not None and used["chips"] + want > q.max_chips:
+            raise AdmissionError(
+                f"quota exceeded in {spec.namespace!r}: job wants {want} "
+                f"chips, {used['chips']}/{q.max_chips} in use"
+            )
+        if q.max_jobs is not None and used["jobs"] + 1 > q.max_jobs:
+            raise AdmissionError(
+                f"quota exceeded in {spec.namespace!r}: "
+                f"{used['jobs']}/{q.max_jobs} jobs already live"
+            )

@@ -1,0 +1,126 @@
+"""TensorBoard controller: serve training logdirs on demand.
+
+The reference's tensorboard-controller turns a ``Tensorboard`` CR into a
+Deployment serving logs from a PVC/GCS path (SURVEY.md §2.5; upstream
+analog [kubeflow/kubeflow] components/tensorboard-controller/ —
+UNVERIFIED, SURVEY.md §0). Here a TensorboardSpec becomes a one-replica
+restart-Always job serving the logdir over HTTP. The default payload is
+``kubeflow_tpu.platform.logserver`` (this image's ``tensorboard.main`` CLI
+cannot start — see that module); ``command`` overrides it for images where
+real TensorBoard works, with ``{logdir}``/``{port}`` placeholders.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from pathlib import Path
+
+from kubeflow_tpu.orchestrator.cluster import LocalCluster
+from kubeflow_tpu.orchestrator.envwire import free_port
+from kubeflow_tpu.orchestrator.spec import (
+    JobSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorboardSpec:
+    name: str
+    logdir: str
+    namespace: str = "default"
+    port: int = 0  # 0 → allocate
+    #: override the server command; "{logdir}" and "{port}" are substituted
+    command: tuple[str, ...] | None = None
+
+
+@dataclasses.dataclass
+class TensorboardStatus:
+    phase: str = "Pending"
+    job_uid: str | None = None
+    port: int = 0
+    restarts: int = 0
+    created: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+class TensorboardController:
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+        self._boards: dict[tuple[str, str], tuple[TensorboardSpec, TensorboardStatus]] = {}
+
+    def create(self, spec: TensorboardSpec) -> TensorboardStatus:
+        key = (spec.namespace, spec.name)
+        if key in self._boards:
+            raise ValueError(f"tensorboard {spec.name!r} already exists")
+        port = spec.port or free_port()
+        env: dict[str, str] = {}
+        if spec.command is not None:
+            command = tuple(
+                c.format(logdir=spec.logdir, port=port) for c in spec.command
+            )
+        else:
+            command = (
+                sys.executable, "-m", "kubeflow_tpu.platform.logserver",
+                "--logdir", spec.logdir,
+                "--port", str(port),
+                "--host", "127.0.0.1",
+            )
+            # the payload imports this package; the worker's cwd is its job
+            # workdir, so put our install root on the child's path
+            import kubeflow_tpu
+
+            pkg_root = str(Path(kubeflow_tpu.__file__).resolve().parent.parent)
+            existing = os.environ.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = (
+                f"{pkg_root}:{existing}" if existing else pkg_root
+            )
+        job = JobSpec(
+            name=f"tensorboard-{spec.name}",
+            namespace=spec.namespace,
+            labels={"kubeflow-tpu/tensorboard": spec.name},
+            replicas={
+                "server": ReplicaSpec(
+                    replicas=1,
+                    command=command,
+                    env=env,
+                    restart_policy=RestartPolicy.ALWAYS,
+                )
+            },
+            run_policy=RunPolicy(backoff_limit=1_000_000),
+        )
+        status = TensorboardStatus(port=port)
+        status.job_uid = self.cluster.submit(job)
+        self._boards[key] = (spec, status)
+        return status
+
+    def get(self, name: str, namespace: str = "default") -> TensorboardStatus:
+        spec, status = self._boards[(namespace, name)]
+        job = self.cluster.get(status.job_uid) if status.job_uid else None
+        if job is not None:
+            worker = self.cluster.workers.get(f"{status.job_uid}/server-0")
+            status.restarts = worker.restarts if worker else 0
+            if job.status.finished:
+                status.phase = "Failed"
+            elif status.restarts >= 3:
+                # restart-Always masks a broken payload as Running forever;
+                # surface the crash loop instead.
+                status.phase = "CrashLooping"
+            else:
+                status.phase = job.status.phase
+        return status
+
+    def list(self, namespace: str = "default") -> list[TensorboardSpec]:
+        return [s for (ns, _), (s, _) in self._boards.items() if ns == namespace]
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        entry = self._boards.pop((namespace, name), None)
+        if entry and entry[1].job_uid:
+            self.cluster.delete(entry[1].job_uid)

@@ -244,6 +244,35 @@ def test_supervisor_ignores_non_elastic_groups(cluster):
     assert status.restart_count >= 1
 
 
+def test_supervisor_covers_explicitly_supervised_master(cluster):
+    """With supervised_replica_types including the master, a master that
+    never beats is killed at grace expiry — the PyTorchJob-style case where
+    the coordinator is itself a trainer."""
+    spec = JobSpec(
+        name="watched-master",
+        replicas={
+            "master": ReplicaSpec(
+                replicas=1,
+                command=(PY, "-c", HANG_THEN_OK),
+                restart_policy=RestartPolicy.ON_FAILURE,
+            ),
+            "worker": ReplicaSpec(
+                replicas=1, command=(PY, "-c", HANG_THEN_OK),
+                restart_policy=RestartPolicy.ON_FAILURE,
+            ),
+        },
+        elastic=ElasticPolicy(
+            replica_type="worker",
+            supervised_replica_types=("master", "worker"),
+            heartbeat_timeout_seconds=0.4,
+        ),
+    )
+    uid = cluster.submit(spec)
+    status = cluster.wait(uid, timeout=60)
+    assert status.phase == "Succeeded", [c.to_dict() for c in status.conditions]
+    assert status.restart_count == 1
+
+
 def test_supervisor_respects_startup_grace(cluster, tmp_path):
     sup = cluster.supervisor
     spec = JobSpec(
