@@ -147,6 +147,7 @@ class ObsServer:
     def start(self) -> "ObsServer":
         if self._thread is not None:
             return self
+        start_error: list[BaseException] = []
 
         def run():
             from aiohttp import web
@@ -164,7 +165,12 @@ class ObsServer:
                 self.port = runner.addresses[0][1]
                 self._started.set()
 
-            loop.run_until_complete(serve())
+            try:
+                loop.run_until_complete(serve())
+            except BaseException as e:  # noqa: BLE001 — reported to caller
+                start_error.append(e)
+                loop.close()
+                return
             loop.run_forever()
             loop.run_until_complete(self._runner.cleanup())
             loop.close()
@@ -174,7 +180,12 @@ class ObsServer:
         )
         self._thread.start()
         if not self._started.wait(timeout=10):
-            raise RuntimeError("obs server failed to start")
+            # reset so a retry actually retries instead of no-opping
+            self._thread.join(timeout=1)
+            self._thread = None
+            self._loop = None
+            cause = start_error[0] if start_error else None
+            raise RuntimeError(f"obs server failed to start: {cause}") from cause
         logger.info("obs server on http://%s:%d", self.host, self.port)
         return self
 

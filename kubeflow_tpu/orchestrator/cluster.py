@@ -66,6 +66,10 @@ class LocalCluster:
             self.jobs, self.workers, self.launcher
         )
         self.admission = admission or AdmissionChain()
+        # admission validators read live state (quota usage); serializing
+        # admit+create closes the check-then-act window between concurrent
+        # submits (concurrent deletes only free capacity, the safe direction)
+        self._submit_lock = threading.Lock()
         self._resync = resync_period
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -127,8 +131,9 @@ class LocalCluster:
     # -- job API (what the SDK client calls) --------------------------- #
 
     def submit(self, spec: JobSpec) -> str:
-        spec = self.admission.admit(spec)
-        self.jobs.create(spec.uid, JobObject(spec=spec))
+        with self._submit_lock:
+            spec = self.admission.admit(spec)
+            self.jobs.create(spec.uid, JobObject(spec=spec))
         self._wake.set()
         return spec.uid
 

@@ -290,11 +290,23 @@ class JobSpec:
                 raise ValueError(f"replica group {rtype!r} needs replicas >= 1")
             if not spec.command:
                 raise ValueError(f"replica group {rtype!r} needs a command")
-        if self.elastic is not None and self.elastic.replica_type not in self.replicas:
-            raise ValueError(
-                f"elastic.replica_type {self.elastic.replica_type!r} "
-                "is not a replica group of this job"
-            )
+        if self.elastic is not None:
+            if self.elastic.replica_type not in self.replicas:
+                raise ValueError(
+                    f"elastic.replica_type {self.elastic.replica_type!r} "
+                    "is not a replica group of this job"
+                )
+            unknown = [
+                t
+                for t in self.elastic.supervised_types()
+                if t not in self.replicas
+            ]
+            if unknown:
+                # a typo here would silently disarm hung-worker detection
+                raise ValueError(
+                    f"supervised_replica_types {unknown} are not replica "
+                    f"groups of this job (groups: {sorted(self.replicas)})"
+                )
 
     # ------------------------------------------------------------------ #
 

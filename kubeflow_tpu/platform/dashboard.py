@@ -91,35 +91,29 @@ class DashboardServer:
     def notebooks_view(self) -> list[dict]:
         if self.notebooks is None:
             return []
-        self.notebooks.reconcile()
-        out = []
-        for (ns, name), (spec, status) in self.notebooks._notebooks.items():
-            out.append(
-                {
-                    "name": name,
-                    "namespace": ns,
-                    "phase": status.phase,
-                    "idle_seconds": round(time.time() - status.last_activity, 1),
-                }
-            )
-        return out
+        return [
+            {
+                "name": spec.name,
+                "namespace": spec.namespace,
+                "phase": status.phase,
+                "idle_seconds": round(time.time() - status.last_activity, 1),
+            }
+            for spec, status in self.notebooks.statuses()
+        ]
 
     def tensorboards_view(self) -> list[dict]:
         if self.tensorboards is None:
             return []
-        out = []
-        for (ns, name), (spec, status) in self.tensorboards._boards.items():
-            st = self.tensorboards.get(name, ns)
-            out.append(
-                {
-                    "name": name,
-                    "namespace": ns,
-                    "phase": st.phase,
-                    "url": st.url,
-                    "logdir": spec.logdir,
-                }
-            )
-        return out
+        return [
+            {
+                "name": spec.name,
+                "namespace": spec.namespace,
+                "phase": status.phase,
+                "url": status.url,
+                "logdir": spec.logdir,
+            }
+            for spec, status in self.tensorboards.statuses()
+        ]
 
     def summary_view(self) -> dict:
         jobs = self.jobs_view()
@@ -163,6 +157,7 @@ class DashboardServer:
     def start(self) -> "DashboardServer":
         if self._thread is not None:
             return self
+        start_error: list[BaseException] = []
 
         def run():
             from aiohttp import web
@@ -180,7 +175,12 @@ class DashboardServer:
                 self.port = runner.addresses[0][1]
                 self._started.set()
 
-            loop.run_until_complete(serve())
+            try:
+                loop.run_until_complete(serve())
+            except BaseException as e:  # noqa: BLE001 — reported to caller
+                start_error.append(e)
+                loop.close()
+                return
             loop.run_forever()
             loop.run_until_complete(self._runner.cleanup())
             loop.close()
@@ -190,7 +190,12 @@ class DashboardServer:
         )
         self._thread.start()
         if not self._started.wait(timeout=10):
-            raise RuntimeError("dashboard failed to start")
+            # reset so a retry actually retries instead of no-opping
+            self._thread.join(timeout=1)
+            self._thread = None
+            self._loop = None
+            cause = start_error[0] if start_error else None
+            raise RuntimeError(f"dashboard failed to start: {cause}") from cause
         return self
 
     def stop(self) -> None:

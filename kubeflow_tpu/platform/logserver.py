@@ -114,11 +114,16 @@ def make_app(logdir: Path):
     from aiohttp import web
 
     async def index(request):
+        import html
+        from urllib.parse import quote
+
         runs = "".join(
-            f'<li><a href="/api/scalars?run={r}">{r}</a></li>'
+            f'<li><a href="/api/scalars?run={quote(r)}">{html.escape(r)}</a></li>'
             for r in find_runs(logdir)
         )
-        profiles = "".join(f"<li>{p}</li>" for p in find_profiles(logdir))
+        profiles = "".join(
+            f"<li>{html.escape(p)}</li>" for p in find_profiles(logdir)
+        )
         return web.Response(
             text=_INDEX_HTML.format(
                 logdir=logdir, runs=runs or "<li>(none)</li>",

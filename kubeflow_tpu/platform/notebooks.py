@@ -15,6 +15,7 @@ resubmits a culled notebook — scale-to-zero semantics for workspaces.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 
 from kubeflow_tpu.obs import heartbeat as hb
@@ -51,28 +52,43 @@ class NotebookStatus:
 class NotebookController:
     def __init__(self, cluster: LocalCluster):
         self.cluster = cluster
+        # RLock: any thread (app, dashboard) may call into the controller;
+        # reconcile iterates + mutates, so all access serializes here.
+        self._lock = threading.RLock()
         self._notebooks: dict[tuple[str, str], tuple[NotebookSpec, NotebookStatus]] = {}
 
     # -- CRUD ----------------------------------------------------------- #
 
     def create(self, spec: NotebookSpec) -> NotebookStatus:
-        key = (spec.namespace, spec.name)
-        if key in self._notebooks:
-            raise ValueError(f"notebook {spec.name!r} already exists")
-        status = NotebookStatus()
-        self._notebooks[key] = (spec, status)
-        self._start(spec, status)
-        return status
+        with self._lock:
+            key = (spec.namespace, spec.name)
+            if key in self._notebooks:
+                raise ValueError(f"notebook {spec.name!r} already exists")
+            status = NotebookStatus()
+            self._notebooks[key] = (spec, status)
+            self._start(spec, status)
+            return status
 
     def get(self, name: str, namespace: str = "default") -> NotebookStatus:
-        self.reconcile()
-        return self._notebooks[(namespace, name)][1]
+        with self._lock:
+            self.reconcile()
+            return self._notebooks[(namespace, name)][1]
 
     def list(self, namespace: str = "default") -> list[NotebookSpec]:
-        return [s for (ns, _), (s, _) in self._notebooks.items() if ns == namespace]
+        with self._lock:
+            return [
+                s for (ns, _), (s, _) in self._notebooks.items() if ns == namespace
+            ]
+
+    def statuses(self) -> list[tuple[NotebookSpec, NotebookStatus]]:
+        """Reconciled (spec, status) snapshot across all namespaces."""
+        with self._lock:
+            self.reconcile()
+            return [(s, st) for (s, st) in self._notebooks.values()]
 
     def delete(self, name: str, namespace: str = "default") -> None:
-        entry = self._notebooks.pop((namespace, name), None)
+        with self._lock:
+            entry = self._notebooks.pop((namespace, name), None)
         if entry and entry[1].job_uid:
             self.cluster.delete(entry[1].job_uid)
 
@@ -80,22 +96,28 @@ class NotebookController:
 
     def touch(self, name: str, namespace: str = "default") -> None:
         """Record user activity (the web app's probe analog)."""
-        self._notebooks[(namespace, name)][1].last_activity = time.time()
+        with self._lock:
+            self._notebooks[(namespace, name)][1].last_activity = time.time()
 
     def wake(self, name: str, namespace: str = "default") -> NotebookStatus:
         """Re-start a culled notebook."""
-        spec, status = self._notebooks[(namespace, name)]
-        if status.phase != "Culled":
+        with self._lock:
+            spec, status = self._notebooks[(namespace, name)]
+            if status.phase != "Culled":
+                return status
+            status.last_activity = time.time()
+            status.culled_at = None
+            self._start(spec, status)
             return status
-        status.last_activity = time.time()
-        status.culled_at = None
-        self._start(spec, status)
-        return status
 
     def reconcile(self, now: float | None = None) -> None:
         """Refresh phases; cull notebooks idle past their deadline."""
         now = time.time() if now is None else now
-        for (ns, name), (spec, status) in self._notebooks.items():
+        with self._lock:
+            self._reconcile_locked(now)
+
+    def _reconcile_locked(self, now: float) -> None:
+        for (ns, name), (spec, status) in list(self._notebooks.items()):
             if status.phase == "Culled" or status.job_uid is None:
                 continue
             job = self.cluster.get(status.job_uid)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -54,9 +55,14 @@ class TensorboardStatus:
 class TensorboardController:
     def __init__(self, cluster: LocalCluster):
         self.cluster = cluster
+        self._lock = threading.RLock()
         self._boards: dict[tuple[str, str], tuple[TensorboardSpec, TensorboardStatus]] = {}
 
     def create(self, spec: TensorboardSpec) -> TensorboardStatus:
+        with self._lock:
+            return self._create_locked(spec)
+
+    def _create_locked(self, spec: TensorboardSpec) -> TensorboardStatus:
         key = (spec.namespace, spec.name)
         if key in self._boards:
             raise ValueError(f"tensorboard {spec.name!r} already exists")
@@ -102,7 +108,8 @@ class TensorboardController:
         return status
 
     def get(self, name: str, namespace: str = "default") -> TensorboardStatus:
-        spec, status = self._boards[(namespace, name)]
+        with self._lock:
+            spec, status = self._boards[(namespace, name)]
         job = self.cluster.get(status.job_uid) if status.job_uid else None
         if job is not None:
             worker = self.cluster.workers.get(f"{status.job_uid}/server-0")
@@ -118,9 +125,21 @@ class TensorboardController:
         return status
 
     def list(self, namespace: str = "default") -> list[TensorboardSpec]:
-        return [s for (ns, _), (s, _) in self._boards.items() if ns == namespace]
+        with self._lock:
+            return [
+                s for (ns, _), (s, _) in self._boards.items() if ns == namespace
+            ]
+
+    def statuses(self) -> list[tuple[TensorboardSpec, TensorboardStatus]]:
+        """Refreshed (spec, status) snapshot across all namespaces."""
+        with self._lock:
+            return [
+                (s, self.get(name, ns))
+                for (ns, name), (s, _) in list(self._boards.items())
+            ]
 
     def delete(self, name: str, namespace: str = "default") -> None:
-        entry = self._boards.pop((namespace, name), None)
+        with self._lock:
+            entry = self._boards.pop((namespace, name), None)
         if entry and entry[1].job_uid:
             self.cluster.delete(entry[1].job_uid)

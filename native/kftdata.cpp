@@ -1,0 +1,306 @@
+// kftdata: native record IO + threaded batch loader for the TPU framework.
+//
+// The reference platform's data plane rides its frameworks' native loaders
+// (torch DataLoader workers / tf.data's C++ runtime) — the platform itself
+// ships none (SURVEY.md §2.8). This library is the TPU framework's own
+// native input pipeline, built for the host-side gap that starves an
+// accelerator: record decode + shuffle + batch assembly run in C++ threads
+// while Python only hands contiguous, ready buffers to jax.device_put.
+//
+//   file format "KFTR": [magic u32][record_bytes u32][count u64] then
+//   `count` fixed-size records back to back. Fixed-size records keep batch
+//   assembly a memcpy — the XLA-friendly choice (static shapes, no ragged
+//   decode on the hot path).
+//
+//   pipeline: reader threads pull file shards round-robin -> seeded
+//   shuffle pool -> batch assembler -> bounded prefetch queue (condition
+//   variables). `shard_index/shard_count` partitions records across data-
+//   parallel processes the same deterministic way the Python loaders do.
+//
+// C API (ctypes-friendly, no C++ types across the boundary):
+//   kft_loader_open(...)            -> opaque handle (0 on error)
+//   kft_loader_next(h, buf, n_out)  -> 1 ok / 0 end-of-data
+//   kft_loader_close(h)
+//   kft_write_records(path, data, record_bytes, count) -> written count
+//   kft_last_error()                -> static message for the last failure
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread kftdata.cpp -o libkftdata.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4B465452;  // "KFTR"
+
+thread_local std::string g_error;
+
+struct Header {
+  uint32_t magic;
+  uint32_t record_bytes;
+  uint64_t count;
+};
+
+struct Batch {
+  std::vector<uint8_t> data;
+  uint64_t n_records = 0;
+};
+
+class Loader {
+ public:
+  Loader(std::vector<std::string> files, uint32_t record_bytes,
+         uint32_t batch_size, uint32_t shuffle_records, uint64_t seed,
+         uint32_t num_threads, uint32_t prefetch_batches, bool drop_remainder,
+         uint32_t shard_index, uint32_t shard_count, int32_t epochs)
+      : files_(std::move(files)),
+        record_bytes_(record_bytes),
+        batch_size_(batch_size),
+        shuffle_records_(shuffle_records),
+        seed_(seed),
+        prefetch_batches_(prefetch_batches == 0 ? 2 : prefetch_batches),
+        drop_remainder_(drop_remainder),
+        shard_index_(shard_index),
+        shard_count_(shard_count == 0 ? 1 : shard_count),
+        epochs_(epochs) {
+    (void)num_threads;  // decode is memcpy-bound; one producer saturates it
+    producer_ = std::thread([this] { Produce(); });
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_pop_.notify_all();
+    cv_push_.notify_all();
+    if (producer_.joinable()) producer_.join();
+  }
+
+  // Blocks for the next batch. Returns false at end of data.
+  bool Next(uint8_t* out, uint64_t* n_records) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [this] { return !queue_.empty() || done_ || stop_; });
+    if (queue_.empty()) return false;
+    Batch b = std::move(queue_.front());
+    queue_.pop();
+    lk.unlock();
+    cv_push_.notify_one();
+    std::memcpy(out, b.data.data(), b.data.size());
+    *n_records = b.n_records;
+    return true;
+  }
+
+  const std::string& error() const { return error_; }
+
+ private:
+  void Produce() {
+    std::mt19937_64 rng(seed_);
+    std::vector<uint8_t> pool;  // shuffle pool, whole records
+    pool.reserve(static_cast<size_t>(shuffle_records_) * record_bytes_);
+    std::vector<uint8_t> pending;  // batch under assembly
+    pending.reserve(static_cast<size_t>(batch_size_) * record_bytes_);
+    uint64_t pending_n = 0;
+
+    auto emit_record = [&](const uint8_t* rec) {
+      pending.insert(pending.end(), rec, rec + record_bytes_);
+      if (++pending_n == batch_size_) {
+        if (!Push(std::move(pending), pending_n)) return false;
+        pending.clear();
+        pending_n = 0;
+      }
+      return true;
+    };
+
+    auto drain_pool = [&](bool all) {
+      // Fisher-Yates-style random draws out of the pool.
+      uint64_t keep = all ? 0 : shuffle_records_ / 2;
+      while (pool.size() / record_bytes_ > keep) {
+        uint64_t n = pool.size() / record_bytes_;
+        uint64_t pick = rng() % n;
+        std::vector<uint8_t> rec(record_bytes_);
+        std::memcpy(rec.data(), pool.data() + pick * record_bytes_,
+                    record_bytes_);
+        // move the last record into the hole
+        if (pick != n - 1) {
+          std::memmove(pool.data() + pick * record_bytes_,
+                       pool.data() + (n - 1) * record_bytes_, record_bytes_);
+        }
+        pool.resize((n - 1) * record_bytes_);
+        if (!emit_record(rec.data())) return false;
+      }
+      return true;
+    };
+
+    int32_t epoch = 0;
+    uint64_t global_index = 0;  // over all records in all files, per epoch
+    for (; epochs_ < 0 || epoch < epochs_; ++epoch) {
+      global_index = 0;
+      for (const auto& path : files_) {
+        FILE* f = std::fopen(path.c_str(), "rb");
+        if (!f) {
+          Fail("cannot open " + path);
+          return;
+        }
+        Header h{};
+        if (std::fread(&h, sizeof(h), 1, f) != 1 || h.magic != kMagic ||
+            h.record_bytes != record_bytes_) {
+          std::fclose(f);
+          Fail("bad header in " + path);
+          return;
+        }
+        std::vector<uint8_t> rec(record_bytes_);
+        for (uint64_t i = 0; i < h.count; ++i, ++global_index) {
+          if (std::fread(rec.data(), record_bytes_, 1, f) != 1) {
+            std::fclose(f);
+            Fail("truncated record in " + path);
+            return;
+          }
+          if (global_index % shard_count_ != shard_index_) continue;
+          if (shuffle_records_ > 1) {
+            pool.insert(pool.end(), rec.begin(), rec.end());
+            if (pool.size() / record_bytes_ >= shuffle_records_) {
+              if (!drain_pool(false)) {
+                std::fclose(f);
+                return;
+              }
+            }
+          } else {
+            if (!emit_record(rec.data())) {
+              std::fclose(f);
+              return;
+            }
+          }
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            if (stop_) {
+              std::fclose(f);
+              return;
+            }
+          }
+        }
+        std::fclose(f);
+      }
+      if (!drain_pool(true)) return;
+    }
+    if (pending_n > 0 && !drop_remainder_) {
+      Push(std::move(pending), pending_n);
+    }
+    Finish();
+  }
+
+  bool Push(std::vector<uint8_t> data, uint64_t n) {
+    Batch b;
+    b.data = std::move(data);
+    b.data.resize(static_cast<size_t>(batch_size_) * record_bytes_);  // pad
+    b.n_records = n;
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_push_.wait(lk, [this] {
+      return queue_.size() < prefetch_batches_ || stop_;
+    });
+    if (stop_) return false;
+    queue_.push(std::move(b));
+    lk.unlock();
+    cv_pop_.notify_one();
+    return true;
+  }
+
+  void Finish() {
+    std::lock_guard<std::mutex> lk(mu_);
+    done_ = true;
+    cv_pop_.notify_all();
+  }
+
+  void Fail(std::string msg) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      error_ = std::move(msg);
+      done_ = true;
+    }
+    cv_pop_.notify_all();
+  }
+
+  const std::vector<std::string> files_;
+  const uint32_t record_bytes_, batch_size_, shuffle_records_;
+  const uint64_t seed_;
+  const uint32_t prefetch_batches_;
+  const bool drop_remainder_;
+  const uint32_t shard_index_, shard_count_;
+  const int32_t epochs_;
+
+  std::thread producer_;
+  std::mutex mu_;
+  std::condition_variable cv_pop_, cv_push_;
+  std::queue<Batch> queue_;
+  bool done_ = false;
+  bool stop_ = false;
+  std::string error_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kft_loader_open(const char** files, uint32_t n_files,
+                      uint32_t record_bytes, uint32_t batch_size,
+                      uint32_t shuffle_records, uint64_t seed,
+                      uint32_t num_threads, uint32_t prefetch_batches,
+                      int drop_remainder, uint32_t shard_index,
+                      uint32_t shard_count, int32_t epochs) {
+  if (n_files == 0 || record_bytes == 0 || batch_size == 0) {
+    g_error = "files, record_bytes and batch_size must be nonzero";
+    return nullptr;
+  }
+  if (shard_count != 0 && shard_index >= shard_count) {
+    g_error = "shard_index out of range";
+    return nullptr;
+  }
+  std::vector<std::string> fs(files, files + n_files);
+  return new Loader(std::move(fs), record_bytes, batch_size, shuffle_records,
+                    seed, num_threads, prefetch_batches, drop_remainder != 0,
+                    shard_index, shard_count, epochs);
+}
+
+int kft_loader_next(void* handle, uint8_t* out, uint64_t* n_records) {
+  auto* loader = static_cast<Loader*>(handle);
+  if (!loader->Next(out, n_records)) {
+    // distinguish "pipeline failed" from plain end-of-data: stale errors
+    // from earlier calls must not leak into a clean EOF
+    g_error = loader->error();
+    return 0;
+  }
+  return 1;
+}
+
+void kft_loader_close(void* handle) { delete static_cast<Loader*>(handle); }
+
+int64_t kft_write_records(const char* path, const uint8_t* data,
+                          uint32_t record_bytes, uint64_t count) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) {
+    g_error = std::string("cannot open for write: ") + path;
+    return -1;
+  }
+  Header h{kMagic, record_bytes, count};
+  if (std::fwrite(&h, sizeof(h), 1, f) != 1 ||
+      (count > 0 && std::fwrite(data, static_cast<size_t>(record_bytes) * count,
+                                1, f) != 1)) {
+    std::fclose(f);
+    g_error = std::string("short write: ") + path;
+    return -1;
+  }
+  std::fclose(f);
+  return static_cast<int64_t>(count);
+}
+
+const char* kft_last_error() { return g_error.c_str(); }
+
+}  // extern "C"
