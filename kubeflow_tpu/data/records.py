@@ -50,19 +50,24 @@ def _cache_dir() -> Path:
 
 
 def ensure_built(force: bool = False) -> Path:
-    """Compile libkftdata.so if missing/stale; returns its path."""
+    """Compile libkftdata.so if missing/stale; returns its path. Compiles
+    to a per-pid temp name and publishes with os.replace so concurrent
+    processes sharing the cache never dlopen a half-written .so."""
     out = _cache_dir() / "libkftdata.so"
     if not force and out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
         return out
+    tmp = out.with_suffix(f".so.tmp-{os.getpid()}")
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        str(_SRC), "-o", str(out),
+        str(_SRC), "-o", str(tmp),
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
         raise NativeBuildError(
             f"g++ failed ({proc.returncode}):\n{proc.stderr[-2000:]}"
         )
+    os.replace(tmp, out)
     return out
 
 
@@ -263,7 +268,9 @@ class RecordLoader:
             if err:
                 raise OSError(err)
             raise StopIteration
-        return self.spec.unpack(self._buf, int(n.value))
+        # copy out of the reused fill buffer: unpack() returns views, and a
+        # consumer holding batch N across next() must not see batch N+1
+        return self.spec.unpack(self._buf.copy(), int(n.value))
 
     def close(self) -> None:
         if self._handle is not None:
@@ -319,9 +326,11 @@ class PyRecordLoader:
             for path in self.files:
                 raw = np.fromfile(path, dtype=np.uint8)
                 header = raw[: _HEADER.itemsize].view(_HEADER)[0]
-                if header["magic"] != _MAGIC:
-                    raise OSError(f"bad header in {path}")
                 rb = int(header["record_bytes"])
+                if header["magic"] != _MAGIC or rb != self.spec.record_bytes:
+                    # same contract as the native loader: a record-size
+                    # mismatch must fail fast, never parse at wrong offsets
+                    raise OSError(f"bad header in {path}")
                 body = raw[_HEADER.itemsize :].reshape(-1, rb)
                 for rec in body:
                     if index % self.shard_count == self.shard_index:

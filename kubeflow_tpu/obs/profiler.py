@@ -28,6 +28,7 @@ from pathlib import Path
 from typing import Any, Callable
 
 from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs.webhost import ThreadedAiohttpServer
 
 logger = logging.getLogger(__name__)
 
@@ -57,8 +58,10 @@ def trace_step(fn: Callable[[], Any], logdir: str | Path, name: str = "step") ->
     return out
 
 
-class ObsServer:
+class ObsServer(ThreadedAiohttpServer):
     """Observability sidecar-in-process. Thread-hosted aiohttp app."""
+
+    thread_name = "kft-obs-server"
 
     def __init__(
         self,
@@ -69,15 +72,10 @@ class ObsServer:
         profile_logdir: str | Path | None = None,
         state_fn: Callable[[], Any] | None = None,
     ):
-        self.host = host
+        super().__init__(host=host, port=port)
         self.registry = registry or prom.REGISTRY
         self.profile_logdir = Path(profile_logdir or "profiles")
         self.state_fn = state_fn
-        self.port = port
-        self._loop: asyncio.AbstractEventLoop | None = None
-        self._thread: threading.Thread | None = None
-        self._started = threading.Event()
-        self._runner = None
         self._profiling = threading.Lock()
 
     # -- handlers ------------------------------------------------------- #
@@ -145,65 +143,6 @@ class ObsServer:
         return app
 
     def start(self) -> "ObsServer":
-        if self._thread is not None:
-            return self
-        start_error: list[BaseException] = []
-
-        def run():
-            from aiohttp import web
-
-            loop = asyncio.new_event_loop()
-            asyncio.set_event_loop(loop)
-            self._loop = loop
-
-            async def serve():
-                runner = web.AppRunner(self._make_app())
-                await runner.setup()
-                site = web.TCPSite(runner, self.host, self.port)
-                await site.start()
-                self._runner = runner
-                self.port = runner.addresses[0][1]
-                self._started.set()
-
-            try:
-                loop.run_until_complete(serve())
-            except BaseException as e:  # noqa: BLE001 — reported to caller
-                start_error.append(e)
-                loop.close()
-                return
-            loop.run_forever()
-            loop.run_until_complete(self._runner.cleanup())
-            loop.close()
-
-        self._thread = threading.Thread(
-            target=run, daemon=True, name="kft-obs-server"
-        )
-        self._thread.start()
-        if not self._started.wait(timeout=10):
-            # reset so a retry actually retries instead of no-opping
-            self._thread.join(timeout=1)
-            self._thread = None
-            self._loop = None
-            cause = start_error[0] if start_error else None
-            raise RuntimeError(f"obs server failed to start: {cause}") from cause
+        super().start()
         logger.info("obs server on http://%s:%d", self.host, self.port)
         return self
-
-    def stop(self) -> None:
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
-        self._loop = None
-        self._started.clear()
-
-    @property
-    def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
-
-    def __enter__(self) -> "ObsServer":
-        return self.start()
-
-    def __exit__(self, *exc) -> None:
-        self.stop()

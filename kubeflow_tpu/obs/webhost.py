@@ -1,0 +1,104 @@
+"""Shared thread-hosted aiohttp server base.
+
+Every long-lived HTTP surface in the framework (obs endpoints, the
+dashboard, ad-hoc servers) runs the same way: an aiohttp app on a daemon
+thread with its own event loop. This base owns that lifecycle once —
+including the failure path: a bind error in the thread surfaces to the
+``start()`` caller immediately (not after a timeout) and resets state so a
+retry actually retries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+
+class ThreadedAiohttpServer:
+    """Subclass and implement ``_make_app() -> aiohttp.web.Application``."""
+
+    thread_name = "kft-web"
+
+    def __init__(self, *, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._runner = None
+        self._started = threading.Event()
+        self._settled = threading.Event()  # set on success OR failure
+        self._start_error: BaseException | None = None
+
+    def _make_app(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._started.clear()
+        self._settled.clear()
+        self._start_error = None
+
+        def run():
+            from aiohttp import web
+
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def serve():
+                runner = web.AppRunner(self._make_app())
+                await runner.setup()
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+                self._runner = runner
+                self.port = runner.addresses[0][1]
+                self._started.set()
+                self._settled.set()
+
+            try:
+                loop.run_until_complete(serve())
+            except BaseException as e:  # noqa: BLE001 — reported to caller
+                self._start_error = e
+                self._settled.set()
+                loop.close()
+                return
+            loop.run_forever()
+            loop.run_until_complete(self._runner.cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name=self.thread_name
+        )
+        self._thread.start()
+        self._settled.wait(timeout=10)
+        if not self._started.is_set():
+            # reset so a retry actually retries instead of no-opping
+            self._thread.join(timeout=1)
+            self._thread = None
+            self._loop = None
+            cause = self._start_error
+            raise RuntimeError(
+                f"{self.thread_name} failed to start: {cause}"
+            ) from cause
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._loop = None
+        self._started.clear()
+        self._settled.clear()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

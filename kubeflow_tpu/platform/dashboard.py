@@ -15,18 +15,19 @@ frontend — or ``curl`` — can see the whole platform at once.
 
 from __future__ import annotations
 
-import asyncio
 import json
-import threading
 import time
 
+from kubeflow_tpu.obs.webhost import ThreadedAiohttpServer
 from kubeflow_tpu.orchestrator.cluster import LocalCluster
 from kubeflow_tpu.platform.notebooks import NotebookController
 from kubeflow_tpu.platform.profiles import ProfileController, job_chips
 from kubeflow_tpu.platform.tensorboards import TensorboardController
 
 
-class DashboardServer:
+class DashboardServer(ThreadedAiohttpServer):
+    thread_name = "kft-dashboard"
+
     def __init__(
         self,
         cluster: LocalCluster,
@@ -37,16 +38,11 @@ class DashboardServer:
         host: str = "127.0.0.1",
         port: int = 0,
     ):
+        super().__init__(host=host, port=port)
         self.cluster = cluster
         self.profiles = profiles
         self.notebooks = notebooks
         self.tensorboards = tensorboards
-        self.host = host
-        self.port = port
-        self._loop: asyncio.AbstractEventLoop | None = None
-        self._thread: threading.Thread | None = None
-        self._runner = None
-        self._started = threading.Event()
 
     # -- views ---------------------------------------------------------- #
 
@@ -154,65 +150,3 @@ class DashboardServer:
         app.router.add_get("/api/tensorboards", handler(self.tensorboards_view))
         return app
 
-    def start(self) -> "DashboardServer":
-        if self._thread is not None:
-            return self
-        start_error: list[BaseException] = []
-
-        def run():
-            from aiohttp import web
-
-            loop = asyncio.new_event_loop()
-            asyncio.set_event_loop(loop)
-            self._loop = loop
-
-            async def serve():
-                runner = web.AppRunner(self._make_app())
-                await runner.setup()
-                site = web.TCPSite(runner, self.host, self.port)
-                await site.start()
-                self._runner = runner
-                self.port = runner.addresses[0][1]
-                self._started.set()
-
-            try:
-                loop.run_until_complete(serve())
-            except BaseException as e:  # noqa: BLE001 — reported to caller
-                start_error.append(e)
-                loop.close()
-                return
-            loop.run_forever()
-            loop.run_until_complete(self._runner.cleanup())
-            loop.close()
-
-        self._thread = threading.Thread(
-            target=run, daemon=True, name="kft-dashboard"
-        )
-        self._thread.start()
-        if not self._started.wait(timeout=10):
-            # reset so a retry actually retries instead of no-opping
-            self._thread.join(timeout=1)
-            self._thread = None
-            self._loop = None
-            cause = start_error[0] if start_error else None
-            raise RuntimeError(f"dashboard failed to start: {cause}") from cause
-        return self
-
-    def stop(self) -> None:
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
-        self._loop = None
-        self._started.clear()
-
-    @property
-    def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
-
-    def __enter__(self) -> "DashboardServer":
-        return self.start()
-
-    def __exit__(self, *exc) -> None:
-        self.stop()

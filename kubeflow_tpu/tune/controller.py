@@ -200,7 +200,16 @@ class ExperimentController:
                 budget = spec.max_trial_count - len(self.trials)
                 want = min(spec.parallel_trial_count - len(pending), budget)
                 if want > 0:
-                    suggestions = self.suggester.suggest(want, self._history())
+                    # lineage-aware algorithms (PBT) need trial identities,
+                    # not just (params, value) pairs
+                    if hasattr(self.suggester, "suggest_trials"):
+                        with self._lock:
+                            snapshot = list(self.trials)
+                        suggestions = self.suggester.suggest_trials(
+                            want, snapshot
+                        )
+                    else:
+                        suggestions = self.suggester.suggest(want, self._history())
                     if not suggestions and not pending:
                         reason = "search space exhausted"
                         break

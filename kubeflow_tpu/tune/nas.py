@@ -1,0 +1,246 @@
+"""Neural architecture search: differentiable (DARTS-style) one-shot NAS.
+
+The reference ships NAS as Katib suggestion services (ENAS, DARTS — Katib
+pkg/suggestion/v1beta1/nas/{enas,darts}/ upstream analog, UNVERIFIED,
+SURVEY.md §0) whose trials train torch supernets. TPU-natively the whole
+search IS one SPMD program: the supernet's mixed edge — a softmax(alpha)-
+weighted sum over candidate ops — is dense math XLA fuses onto the MXU, and
+the bilevel step (weights on the train split, architecture params on the
+val split) is two jitted updates. No controller/service split is needed;
+the searcher runs in-process or inside any JAXJob trial.
+
+Search space: a single cell DAG of ``nodes`` intermediate nodes; every
+edge (i→j) mixes the candidate ops. ``derive()`` returns the discrete
+architecture (argmax op per edge, top-2 edges per node, DARTS-style).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+#: candidate op name → flax module factory (channels → module)
+OPS: dict[str, Callable[[int], nn.Module]] = {}
+
+
+def _register(name):
+    def deco(factory):
+        OPS[name] = factory
+        return factory
+
+    return deco
+
+
+@_register("conv3")
+def _conv3(ch):
+    return nn.Conv(ch, (3, 3), padding="SAME")
+
+
+@_register("conv1")
+def _conv1(ch):
+    return nn.Conv(ch, (1, 1))
+
+
+@_register("skip")
+def _skip(ch):
+    class Skip(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x
+
+    return Skip()
+
+
+@_register("zero")
+def _zero(ch):
+    class Zero(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return jnp.zeros_like(x)
+
+    return Zero()
+
+
+@_register("maxpool")
+def _maxpool(ch):
+    class Pool(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.max_pool(
+                x, (3, 3), strides=(1, 1), padding="SAME"
+            )
+
+    return Pool()
+
+
+@dataclasses.dataclass(frozen=True)
+class NASSpace:
+    """Cell-based space (the Katib NAS operations/graph config analog)."""
+
+    ops: tuple[str, ...] = ("conv3", "conv1", "skip", "maxpool", "zero")
+    nodes: int = 3  # intermediate nodes; node j gets edges from all i<j+1
+    channels: int = 16
+    num_classes: int = 10
+    #: [H, W, C] of the images the searcher will see — the stem conv's
+    #: params are initialized against this shape
+    input_shape: tuple[int, int, int] = (8, 8, 1)
+
+    def __post_init__(self):
+        unknown = [o for o in self.ops if o not in OPS]
+        if unknown:
+            raise ValueError(f"unknown ops {unknown}; have {sorted(OPS)}")
+
+    @property
+    def edges(self) -> list[tuple[int, int]]:
+        """(from_node, to_node); node 0 is the cell input."""
+        return [(i, j) for j in range(1, self.nodes + 1) for i in range(j)]
+
+
+class SuperNet(nn.Module):
+    """One-shot model: stem → mixed-op cell → head. Architecture weights
+    ``alpha`` [n_edges, n_ops] come in as an argument so the same apply
+    serves both bilevel updates."""
+
+    space: NASSpace
+
+    @nn.compact
+    def __call__(self, x, alpha):
+        sp = self.space
+        x = nn.Conv(sp.channels, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        states = [x]
+        for j in range(1, sp.nodes + 1):
+            acc = 0.0
+            for e, (i, jj) in enumerate(sp.edges):
+                if jj != j:
+                    continue
+                w = jax.nn.softmax(alpha[e])
+                mixed = 0.0
+                for k, op_name in enumerate(sp.ops):
+                    op = OPS[op_name](sp.channels)
+                    mixed = mixed + w[k] * op(states[i])
+                acc = acc + mixed
+            states.append(nn.relu(nn.LayerNorm()(acc)))
+        out = jnp.mean(states[-1], axis=(1, 2))
+        return nn.Dense(sp.num_classes)(out)
+
+
+@dataclasses.dataclass
+class DerivedCell:
+    """Discrete architecture: chosen op per kept edge."""
+
+    edges: list[tuple[int, int, str]]  # (from, to, op)
+
+    def to_dict(self) -> dict:
+        return {"edges": [list(e) for e in self.edges]}
+
+
+class DARTSSearcher:
+    """First-order DARTS: alternate w-steps (train split) and alpha-steps
+    (val split), both jitted; ``derive`` reads off the discrete cell."""
+
+    def __init__(
+        self,
+        space: NASSpace,
+        *,
+        w_lr: float = 1e-2,
+        alpha_lr: float = 3e-3,
+        seed: int = 0,
+    ):
+        self.space = space
+        self.net = SuperNet(space)
+        rng = jax.random.PRNGKey(seed)
+        n_edges, n_ops = len(space.edges), len(space.ops)
+        self.alpha = jnp.zeros((n_edges, n_ops))
+        dummy = jnp.zeros((1, *space.input_shape))
+        self.w = self.net.init(rng, dummy, self.alpha)
+        self.w_opt = optax.adam(w_lr)
+        self.a_opt = optax.adam(alpha_lr)
+        self.w_state = self.w_opt.init(self.w)
+        self.a_state = self.a_opt.init(self.alpha)
+        self._w_step = jax.jit(self._make_step(wrt="w"))
+        self._a_step = jax.jit(self._make_step(wrt="alpha"))
+
+    def _loss(self, w, alpha, batch):
+        logits = self.net.apply(w, batch["image"], alpha)
+        labels = batch["label"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    def _make_step(self, wrt: str):
+        def step(w, alpha, opt_state, batch):
+            if wrt == "w":
+                loss, g = jax.value_and_grad(self._loss, argnums=0)(
+                    w, alpha, batch
+                )
+                updates, opt_state = self.w_opt.update(g, opt_state, w)
+                return optax.apply_updates(w, updates), opt_state, loss
+            loss, g = jax.value_and_grad(self._loss, argnums=1)(
+                w, alpha, batch
+            )
+            updates, opt_state = self.a_opt.update(g, opt_state, alpha)
+            return optax.apply_updates(alpha, updates), opt_state, loss
+
+        return step
+
+    def step(
+        self,
+        train_batch: Mapping[str, Any],
+        val_batch: Mapping[str, Any],
+    ) -> dict[str, float]:
+        """One bilevel iteration; returns both losses."""
+        self.w, self.w_state, w_loss = self._w_step(
+            self.w, self.alpha, self.w_state, train_batch
+        )
+        self.alpha, self.a_state, a_loss = self._a_step(
+            self.w, self.alpha, self.a_state, val_batch
+        )
+        return {"w_loss": float(w_loss), "alpha_loss": float(a_loss)}
+
+    def search(
+        self,
+        data: Callable[[int], tuple[Mapping[str, Any], Mapping[str, Any]]],
+        steps: int,
+    ) -> DerivedCell:
+        for i in range(steps):
+            train_batch, val_batch = data(i)
+            self.step(train_batch, val_batch)
+        return self.derive()
+
+    def derive(self, keep_per_node: int = 2) -> DerivedCell:
+        """Discrete cell: per edge the argmax non-zero op; per node keep the
+        ``keep_per_node`` strongest incoming edges (DARTS derivation)."""
+        sp = self.space
+        alpha = np.asarray(self.alpha)
+        zero_idx = sp.ops.index("zero") if "zero" in sp.ops else None
+        chosen: list[tuple[int, int, str, float]] = []
+        for e, (i, j) in enumerate(sp.edges):
+            probs = np.exp(alpha[e] - alpha[e].max())
+            probs = probs / probs.sum()
+            order = np.argsort(-probs)
+            best = next(
+                (k for k in order if zero_idx is None or k != zero_idx),
+                order[0],
+            )
+            chosen.append((i, j, sp.ops[int(best)], float(probs[best])))
+        edges: list[tuple[int, int, str]] = []
+        for j in range(1, sp.nodes + 1):
+            incoming = sorted(
+                (c for c in chosen if c[1] == j), key=lambda c: -c[3]
+            )[:keep_per_node]
+            edges.extend((i, jj, op) for i, jj, op, _ in incoming)
+        return DerivedCell(edges=edges)
+
+    def alpha_entropy(self) -> float:
+        """Mean per-edge entropy of the op distribution — falls as the
+        search commits to an architecture."""
+        p = jax.nn.softmax(self.alpha, axis=-1)
+        ent = -(p * jnp.log(p + 1e-9)).sum(-1)
+        return float(ent.mean())

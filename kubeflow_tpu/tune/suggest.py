@@ -290,6 +290,81 @@ class HyperbandSuggester(Suggester):
         return out
 
 
+class PBTSuggester(Suggester):
+    """Population Based Training (the Katib PBT service analog).
+
+    Trial-based PBT: each generation's members inherit a top performer's
+    weights via the ``checkpoint_param`` trial parameter (set to the parent
+    trial id — the template maps it to a checkpoint path) and explore by
+    perturbing the parent's hyperparameters (numeric ×{0.8,1.2} in unit
+    space, categoricals resampled with ``resample_prob``). Needs trial
+    identities, so it implements ``suggest_trials``.
+    """
+
+    def __init__(self, spec: ExperimentSpec, seed: int = 0):
+        super().__init__(spec, seed)
+        s = spec.algorithm.settings
+        self.population = int(s.get("population", spec.parallel_trial_count))
+        self.quantile = float(s.get("quantile", 0.25))
+        self.perturb_factors = tuple(s.get("perturb_factors", (0.8, 1.2)))
+        self.resample_prob = float(s.get("resample_prob", 0.25))
+        self.checkpoint_param = s.get("checkpoint_param", "parent_trial")
+
+    def suggest_trials(self, count: int, trials) -> list[TrialAssignment]:
+        from kubeflow_tpu.tune.spec import TrialState
+
+        done = [
+            t
+            for t in trials
+            if t.state is TrialState.SUCCEEDED and t.objective_value is not None
+        ]
+        out = []
+        if len(done) < self.population:
+            for _ in range(count):
+                q = self._random_point()
+                q[self.checkpoint_param] = ""  # fresh member, no parent
+                out.append(TrialAssignment(q))
+            return out
+        sign = self._sign()
+        ranked = sorted(done, key=lambda t: sign * t.objective_value)
+        k = max(1, int(len(ranked) * self.quantile))
+        top = ranked[:k]
+        for _ in range(count):
+            parent = self.rng.choice(top)
+            q = self._exploit_explore(parent.assignment.parameters)
+            q[self.checkpoint_param] = parent.assignment.trial_id
+            out.append(TrialAssignment(q))
+        return out
+
+    def _exploit_explore(self, params: dict) -> dict:
+        q = {}
+        for p in self.params:
+            v = params.get(p.name)
+            if v is None or self.rng.random() < self.resample_prob:
+                q[p.name] = p.from_unit(self.rng.random())
+                continue
+            if p.type.value in ("double", "int"):
+                u = p.to_unit(v) * self.rng.choice(self.perturb_factors)
+                q[p.name] = p.from_unit(min(1.0, max(0.0, u)))
+            else:
+                q[p.name] = v
+        return q
+
+    def suggest(self, count, history):
+        # history-only callers (no lineage): degrade to perturbed top points
+        if not history:
+            return [TrialAssignment(self._random_point()) for _ in range(count)]
+        sign = self._sign()
+        ranked = sorted(history, key=lambda t: sign * t[1])
+        k = max(1, int(len(ranked) * self.quantile))
+        return [
+            TrialAssignment(
+                self._exploit_explore(dict(self.rng.choice(ranked[:k])[0]))
+            )
+            for _ in range(count)
+        ]
+
+
 _REGISTRY = {
     "random": RandomSuggester,
     "grid": GridSuggester,
@@ -299,10 +374,19 @@ _REGISTRY = {
     "hyperopt": TPESuggester,  # alias
     "cmaes": CMAESSuggester,
     "hyperband": HyperbandSuggester,
+    "pbt": PBTSuggester,
 }
 
 
 def make_suggester(spec: ExperimentSpec, seed: int = 0) -> Suggester:
+    if spec.algorithm.name in ("darts", "enas"):
+        # NAS is not a parameter suggester here: TPU-natively the whole
+        # search is ONE differentiable SPMD program (no controller/service
+        # split) — use kubeflow_tpu.tune.nas.DARTSSearcher in the trial.
+        raise ValueError(
+            f"algorithm '{spec.algorithm.name}' runs in-process: use "
+            "kubeflow_tpu.tune.nas (DARTSSearcher) instead of a suggester"
+        )
     try:
         cls = _REGISTRY[spec.algorithm.name]
     except KeyError:

@@ -1,0 +1,157 @@
+"""PBT (lineage-aware population training) + DARTS one-shot NAS
+(SURVEY.md §2.3 suggestion-service rows: pbt, nas/darts)."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.tune.controller import ExperimentController, CallableTrialRunner
+from kubeflow_tpu.tune.spec import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    Objective,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    Trial,
+    TrialAssignment,
+    TrialState,
+)
+from kubeflow_tpu.tune.suggest import PBTSuggester, make_suggester
+
+
+def _pbt_spec(**settings):
+    return ExperimentSpec(
+        name="pbt-e",
+        parameters=(
+            ParameterSpec("lr", ParameterType.DOUBLE, min=1e-4, max=1e-1,
+                          log_scale=True),
+            ParameterSpec("opt", ParameterType.CATEGORICAL,
+                          values=("sgd", "adam")),
+        ),
+        objective=Objective("loss", ObjectiveType.MINIMIZE),
+        algorithm=AlgorithmSpec("pbt", {"population": 4, **settings}),
+        parallel_trial_count=4,
+        max_trial_count=16,
+    )
+
+
+def _done_trial(params, value):
+    t = Trial(assignment=TrialAssignment(dict(params)))
+    t.state = TrialState.SUCCEEDED
+    t.metrics["__objective__"] = value
+    return t
+
+
+def test_pbt_cold_start_is_random_without_parent():
+    sug = make_suggester(_pbt_spec(), seed=0)
+    assert isinstance(sug, PBTSuggester)
+    out = sug.suggest_trials(4, [])
+    assert len(out) == 4
+    assert all(a.parameters["parent_trial"] == "" for a in out)
+
+
+def test_pbt_exploits_top_quantile_with_lineage():
+    sug = make_suggester(_pbt_spec(quantile=0.25), seed=1)
+    trials = [
+        _done_trial({"lr": 1e-2, "opt": "adam"}, 0.1),  # best
+        _done_trial({"lr": 1e-3, "opt": "sgd"}, 0.5),
+        _done_trial({"lr": 1e-4, "opt": "sgd"}, 0.9),
+        _done_trial({"lr": 5e-2, "opt": "adam"}, 1.5),  # worst
+    ]
+    best_id = trials[0].assignment.trial_id
+    out = sug.suggest_trials(8, trials)
+    # quantile 0.25 of 4 → only the best trial is a parent
+    assert all(a.parameters["parent_trial"] == best_id for a in out)
+    # exploration actually perturbs: not every child keeps the parent's lr
+    lrs = {a.parameters["lr"] for a in out}
+    assert len(lrs) > 1
+    for a in out:
+        assert 1e-4 <= a.parameters["lr"] <= 1e-1  # stays in bounds
+
+
+def test_pbt_maximize_objective_picks_highest():
+    spec = ExperimentSpec(
+        name="pbt-max",
+        parameters=(
+            ParameterSpec("lr", ParameterType.DOUBLE, min=0.0, max=1.0),
+        ),
+        objective=Objective("acc", ObjectiveType.MAXIMIZE),
+        algorithm=AlgorithmSpec("pbt", {"population": 2, "quantile": 0.5}),
+        parallel_trial_count=2,
+    )
+    sug = make_suggester(spec, seed=0)
+    lo, hi = _done_trial({"lr": 0.2}, 0.3), _done_trial({"lr": 0.8}, 0.9)
+    out = sug.suggest_trials(4, [lo, hi])
+    assert all(
+        a.parameters["parent_trial"] == hi.assignment.trial_id for a in out
+    )
+
+
+def test_pbt_end_to_end_improves():
+    """Full controller loop: objective is minimized at lr=0.01; PBT's
+    generations should concentrate near it."""
+    spec = _pbt_spec(quantile=0.5)
+
+    def objective(params):
+        return abs(np.log10(params["lr"]) - np.log10(1e-2))
+
+    status = ExperimentController(
+        spec, CallableTrialRunner(objective), seed=3
+    ).run()
+    assert status.complete
+    gen0 = [t for t in status.trials
+            if t.assignment.parameters["parent_trial"] == ""]
+    children = [t for t in status.trials
+                if t.assignment.parameters["parent_trial"] != ""]
+    assert children, "PBT never produced a lineage generation"
+    best = status.optimal.metrics["__objective__"]
+    assert best <= min(t.metrics["__objective__"] for t in gen0)
+
+
+# ------------------------------------------------------------------- DARTS
+
+
+def test_nas_space_validation_and_edges():
+    from kubeflow_tpu.tune.nas import NASSpace
+
+    sp = NASSpace(nodes=3)
+    assert len(sp.edges) == 1 + 2 + 3
+    with pytest.raises(ValueError, match="unknown ops"):
+        NASSpace(ops=("conv3", "wormhole"))
+
+
+@pytest.mark.slow
+def test_darts_search_commits_to_architecture():
+    from kubeflow_tpu.tune.nas import DARTSSearcher, NASSpace
+
+    space = NASSpace(
+        ops=("conv3", "skip", "zero"), nodes=2, channels=8, num_classes=4
+    )
+    searcher = DARTSSearcher(space, seed=0)
+    ent0 = searcher.alpha_entropy()
+
+    rng = np.random.RandomState(0)
+    protos = rng.randn(4, 8, 8, 1).astype(np.float32)
+
+    def data(step):
+        def batch(seed):
+            r = np.random.RandomState(seed)
+            y = r.randint(0, 4, size=16)
+            x = protos[y] + 0.3 * r.randn(16, 8, 8, 1).astype(np.float32)
+            return {"image": x.astype(np.float32), "label": y}
+
+        return batch(step * 2), batch(step * 2 + 1)
+
+    losses = [searcher.step(*data(i)) for i in range(40)]
+    assert losses[-1]["w_loss"] < losses[0]["w_loss"]  # supernet learns
+    assert searcher.alpha_entropy() < ent0  # alphas commit
+
+    cell = searcher.derive()
+    assert cell.edges, "derivation kept no edges"
+    for i, j, op in cell.edges:
+        assert 0 <= i < j <= space.nodes
+        assert op in ("conv3", "skip")  # zero is never derived
+    # node with >2 incoming candidates keeps exactly 2 (DARTS rule)
+    node2 = [e for e in cell.edges if e[1] == 2]
+    assert len(node2) == 2
+    assert cell.to_dict()["edges"]

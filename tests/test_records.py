@@ -136,6 +136,30 @@ def test_native_loader_rejects_bad_input(tmp_path, built):
         )
 
 
+def test_batches_survive_next_call(tmp_path, built):
+    """A held batch must not be overwritten by the following one (the fill
+    buffer is reused internally; returned arrays must be private)."""
+    files, _ = _write_files(tmp_path, per_file=(16,))
+    single = RecordSpec.of(idx=("int64", ()))  # single-field: worst case
+    sfiles = [tmp_path / "s.kftr"]
+    write_records_py(sfiles[0], single, {"idx": np.arange(16, dtype=np.int64)})
+    with RecordLoader(sfiles, single, batch_size=4) as loader:
+        first = next(loader)["idx"]
+        snapshot = first.copy()
+        next(loader)
+        np.testing.assert_array_equal(first, snapshot)
+
+
+def test_python_fallback_rejects_spec_mismatch(tmp_path, built):
+    files, _ = _write_files(tmp_path, per_file=(8,))
+    wrong = RecordSpec.of(image=("float32", (2, 2)), label=("int32", ()))
+    with pytest.raises(OSError, match="bad header"):
+        next(PyRecordLoader(files, wrong, batch_size=2))
+    with pytest.raises(OSError, match="bad header"):
+        loader = RecordLoader(files, wrong, batch_size=2)
+        next(loader)
+
+
 def test_python_fallback_equivalence(tmp_path, built):
     """The fallback must agree with the native loader wherever behavior is
     specified: unshuffled order, sharding, remainder handling."""

@@ -166,6 +166,9 @@ def test_hyperband_escalates_budget():
 
 def test_unknown_algorithm_rejected():
     with pytest.raises(ValueError, match="unknown algorithm"):
+        make_suggester(_exp("simulated-annealing"))
+    # NAS names are known but redirect to the in-process one-shot searcher
+    with pytest.raises(ValueError, match="tune.nas"):
         make_suggester(_exp("darts"))
 
 
