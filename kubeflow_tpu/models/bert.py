@@ -103,7 +103,9 @@ class BertLayer(nn.Module):
         h = BertSelfAttention(cfg, name="attn")(x, attention_mask)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln1")(x + h)
         y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="up_proj")(x)
-        y = nn.gelu(y)
+        # exact (erf) GELU as in the original BERT — the tanh approximation
+        # breaks bit-parity with converted HF checkpoints
+        y = nn.gelu(y, approximate=False)
         y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="down_proj")(y)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln2")(x + y)
 
@@ -154,7 +156,7 @@ class BertForMaskedLM(nn.Module):
             input_ids, attention_mask, token_type_ids
         )
         h = nn.Dense(self.cfg.hidden_size, dtype=self.cfg.dtype, name="mlm_transform")(seq)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)
         h = nn.LayerNorm(epsilon=self.cfg.layer_norm_eps, name="mlm_ln")(h)
         return nn.Dense(
             self.cfg.vocab_size, use_bias=True, dtype=jnp.float32, name="unembed"

@@ -1,0 +1,184 @@
+"""HF checkpoint interop: torch BERT state_dicts → this framework's params.
+
+The reference serves ``bert-base-uncased`` through its HuggingFace runtime
+(SURVEY.md §2.2, BASELINE config 5); a reference user migrating here brings
+torch checkpoints. This module converts an HF ``BertModel`` /
+``BertFor*`` state_dict into ``models.bert.BertEncoder`` params with
+numerical agreement (same weights ⇒ same outputs), so serving and
+fine-tuning continue from the exact same model. Conversion is pure
+numpy — torch is only needed to ``torch.load`` a ``.bin`` file.
+
+Name mapping (HF → ours):
+
+    embeddings.word_embeddings.weight        embed.embedding
+    embeddings.position_embeddings.weight    pos_embedding
+    embeddings.token_type_embeddings.weight  type_embed.embedding
+    embeddings.LayerNorm.{weight,bias}       ln_embed.{scale,bias}
+    encoder.layer.N.attention.self.query     layers_N.attn.q_proj   (kernel^T)
+    …key/value                               …k_proj/v_proj
+    encoder.layer.N.attention.output.dense   layers_N.attn.o_proj
+    encoder.layer.N.attention.output.LayerNorm  layers_N.ln1
+    encoder.layer.N.intermediate.dense       layers_N.up_proj
+    encoder.layer.N.output.dense             layers_N.down_proj
+    encoder.layer.N.output.LayerNorm         layers_N.ln2
+    pooler.dense                             pooler
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+
+from kubeflow_tpu.models.bert import BertConfig
+
+
+def bert_config_from_hf(hf: Mapping[str, Any], **overrides) -> BertConfig:
+    """HF ``config.json`` dict → BertConfig."""
+    base = dict(
+        vocab_size=hf.get("vocab_size", 30522),
+        hidden_size=hf.get("hidden_size", 768),
+        num_layers=hf.get("num_hidden_layers", 12),
+        num_heads=hf.get("num_attention_heads", 12),
+        intermediate_size=hf.get("intermediate_size", 3072),
+        max_position=hf.get("max_position_embeddings", 512),
+        type_vocab_size=hf.get("type_vocab_size", 2),
+        layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+    )
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor without importing torch
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _dense(state, hf_name):
+    return {
+        "kernel": _np(state[f"{hf_name}.weight"]).T,  # torch [out,in] → [in,out]
+        "bias": _np(state[f"{hf_name}.bias"]),
+    }
+
+
+def _layernorm(state, hf_name):
+    return {
+        "scale": _np(state[f"{hf_name}.weight"]),
+        "bias": _np(state[f"{hf_name}.bias"]),
+    }
+
+
+def hf_bert_state_to_params(
+    state: Mapping[str, Any], cfg: BertConfig
+) -> dict:
+    """HF BertModel state_dict → ``BertEncoder`` params pytree.
+
+    Accepts bare ``BertModel`` keys or ``bert.``-prefixed ones (as found
+    inside ``BertForSequenceClassification``/``BertForMaskedLM`` dicts).
+    """
+    if any(k.startswith("bert.") for k in state):
+        state = {
+            k[len("bert."):]: v for k, v in state.items() if k.startswith("bert.")
+        }
+
+    params: dict[str, Any] = {
+        "embed": {
+            "embedding": _np(state["embeddings.word_embeddings.weight"])
+        },
+        "pos_embedding": _np(state["embeddings.position_embeddings.weight"]),
+        "type_embed": {
+            "embedding": _np(state["embeddings.token_type_embeddings.weight"])
+        },
+        "ln_embed": _layernorm(state, "embeddings.LayerNorm"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"encoder.layer.{i}"
+        params[f"layers_{i}"] = {
+            "attn": {
+                "q_proj": _dense(state, f"{p}.attention.self.query"),
+                "k_proj": _dense(state, f"{p}.attention.self.key"),
+                "v_proj": _dense(state, f"{p}.attention.self.value"),
+                "o_proj": _dense(state, f"{p}.attention.output.dense"),
+            },
+            "ln1": _layernorm(state, f"{p}.attention.output.LayerNorm"),
+            "up_proj": _dense(state, f"{p}.intermediate.dense"),
+            "down_proj": _dense(state, f"{p}.output.dense"),
+            "ln2": _layernorm(state, f"{p}.output.LayerNorm"),
+        }
+    if "pooler.dense.weight" in state:
+        params["pooler"] = _dense(state, "pooler.dense")
+    return params
+
+
+def hf_bert_mlm_to_params(state: Mapping[str, Any], cfg: BertConfig) -> dict:
+    """HF ``BertForMaskedLM`` state_dict → ``models.bert.BertForMaskedLM``
+    params (encoder nested under ``encoder``, plus the prediction head when
+    present: ``cls.predictions.transform`` → mlm_transform/mlm_ln, the
+    decoder (tied to word embeddings in HF) → unembed)."""
+    params: dict[str, Any] = {"encoder": hf_bert_state_to_params(state, cfg)}
+    if "cls.predictions.transform.dense.weight" in state:
+        params["mlm_transform"] = _dense(state, "cls.predictions.transform.dense")
+        params["mlm_ln"] = _layernorm(
+            state, "cls.predictions.transform.LayerNorm"
+        )
+        bias_key = (
+            "cls.predictions.decoder.bias"
+            if "cls.predictions.decoder.bias" in state
+            else "cls.predictions.bias"
+        )
+        params["unembed"] = {
+            "kernel": _np(state["cls.predictions.decoder.weight"]).T,
+            "bias": _np(state[bias_key]),
+        }
+    return params
+
+
+def load_bert_dir(model_dir: str | Path, **cfg_overrides):
+    """Load an HF-format model directory (``config.json`` +
+    ``pytorch_model.bin``) → (BertConfig, encoder params). The directory is
+    what the storage initializer materializes from a ``storage_uri``."""
+    model_dir = Path(model_dir)
+    cfg_path = model_dir / "config.json"
+    if not cfg_path.exists():
+        raise FileNotFoundError(f"no config.json under {model_dir}")
+    cfg = bert_config_from_hf(json.loads(cfg_path.read_text()), **cfg_overrides)
+
+    weights = model_dir / "pytorch_model.bin"
+    if not weights.exists():
+        raise FileNotFoundError(
+            f"no pytorch_model.bin under {model_dir} "
+            "(safetensors support: convert externally for now)"
+        )
+    import torch
+
+    state = torch.load(str(weights), map_location="cpu", weights_only=True)
+    return cfg, hf_bert_state_to_params(state, cfg)
+
+
+def is_hf_bert_dir(model_dir: str | Path | None) -> bool:
+    """True when the directory holds an HF-format BERT checkpoint (the
+    layout the storage initializer materializes from a storage_uri)."""
+    if not model_dir:
+        return False
+    p = Path(model_dir)
+    return (p / "config.json").exists() and (p / "pytorch_model.bin").exists()
+
+
+def load_bert_mlm_dir(model_dir: str | Path, **cfg_overrides):
+    """Like ``load_bert_dir`` but shaped for ``BertForMaskedLM`` — head
+    pieces are included when the checkpoint carries them (missing pieces
+    are left to the caller to initialize)."""
+    model_dir = Path(model_dir)
+    cfg = bert_config_from_hf(
+        json.loads((model_dir / "config.json").read_text()), **cfg_overrides
+    )
+    import torch
+
+    state = torch.load(
+        str(model_dir / "pytorch_model.bin"), map_location="cpu",
+        weights_only=True,
+    )
+    return cfg, hf_bert_mlm_to_params(state, cfg)

@@ -7,9 +7,13 @@ Here: tokenize → jitted flax BERT forward with HBM-resident weights →
 decode, with bucket batching (serve/model.py) instead of torch dynamic
 shapes.
 
-No egress ⇒ no pretrained weight downloads; the runtime initialises random
-weights at the configured size (perf-identical for latency benchmarks) or
-loads an Orbax checkpoint directory if one is present at ``storage_path``.
+``storage_path`` resolution order (the /mnt/models contract):
+1. HF-format dir (config.json + pytorch_model.bin) → converted via
+   ``models.convert`` — a reference user's torch BERT checkpoint serves
+   here unchanged, numerically identical;
+2. Orbax checkpoint directory → restored;
+3. otherwise random weights at the configured size (perf-identical for
+   latency benchmarks; no egress ⇒ no downloads).
 """
 
 from __future__ import annotations
@@ -60,6 +64,16 @@ class SimpleTokenizer:
         return ids
 
 
+def _deep_merge(base: dict, override: Mapping) -> dict:
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, Mapping) and isinstance(out.get(k), Mapping):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 class BertRuntimeModel(JAXModel):
     """Text in → MLM logits/top-token out, on the bucketed jitted path."""
 
@@ -72,13 +86,39 @@ class BertRuntimeModel(JAXModel):
         buckets: BucketSpec | None = None,
         sharding: jax.sharding.Sharding | None = None,
     ):
-        cfg = config or bert_base()
+        from kubeflow_tpu.models.convert import is_hf_bert_dir
+
+        hf_dir = is_hf_bert_dir(storage_path)
+        if config is not None:
+            cfg = config
+        elif hf_dir:
+            import json
+
+            from kubeflow_tpu.models.convert import bert_config_from_hf
+
+            cfg = bert_config_from_hf(
+                json.loads(
+                    open(os.path.join(storage_path, "config.json")).read()
+                )
+            )
+        else:
+            cfg = bert_base()
         model = BertForMaskedLM(cfg)
         self.config = cfg
         self.tokenizer = SimpleTokenizer(cfg.vocab_size)
         self._storage_path = storage_path
 
         def init_params():
+            rng = jax.random.PRNGKey(0)
+            ids = np.zeros((1, 8), np.int32)
+            fresh = model.init(rng, ids)["params"]
+            if hf_dir:
+                from kubeflow_tpu.models.convert import load_bert_mlm_dir
+
+                _, converted = load_bert_mlm_dir(storage_path)
+                # checkpoint pieces win; anything it lacks (e.g. an MLM head
+                # absent from a bare BertModel dump) keeps the fresh init
+                return _deep_merge(fresh, converted)
             if storage_path and os.path.isdir(storage_path) and os.listdir(storage_path):
                 import orbax.checkpoint as ocp
 
@@ -87,9 +127,7 @@ class BertRuntimeModel(JAXModel):
                         return ckptr.restore(os.path.abspath(storage_path))
                 except Exception:
                     pass  # fall through to random init (fresh-weights serving)
-            rng = jax.random.PRNGKey(0)
-            ids = np.zeros((1, 8), np.int32)
-            return model.init(rng, ids)["params"]
+            return fresh
 
         def apply_fn(params, input_ids, attention_mask):
             return model.apply(

@@ -1,0 +1,140 @@
+"""HF→JAX checkpoint conversion parity (SURVEY.md §2.2 HuggingFace runtime
+row): the SAME weights must produce the SAME outputs, so reference users'
+torch BERT checkpoints serve and fine-tune here unchanged."""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from kubeflow_tpu.models.bert import BertEncoder  # noqa: E402
+from kubeflow_tpu.models.convert import (  # noqa: E402
+    bert_config_from_hf,
+    hf_bert_state_to_params,
+    load_bert_dir,
+)
+
+HF_CFG = dict(
+    vocab_size=99,
+    hidden_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    intermediate_size=128,
+    max_position_embeddings=64,
+    type_vocab_size=2,
+    hidden_act="gelu",
+    layer_norm_eps=1e-12,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch.manual_seed(0)
+    cfg = transformers.BertConfig(**HF_CFG)
+    model = transformers.BertModel(cfg, add_pooling_layer=True)
+    model.eval()
+    return model
+
+
+def _inputs(batch=3, seq=16, seed=1):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, HF_CFG["vocab_size"], size=(batch, seq))
+    types = rng.randint(0, 2, size=(batch, seq))
+    return ids.astype(np.int32), types.astype(np.int32)
+
+
+def test_config_mapping():
+    cfg = bert_config_from_hf(HF_CFG)
+    assert cfg.hidden_size == 64
+    assert cfg.num_layers == 2
+    assert cfg.num_heads == 4
+    assert cfg.max_position == 64
+
+
+def test_forward_parity_full_mask(hf_model):
+    ids, types = _inputs()
+    with torch.no_grad():
+        out = hf_model(
+            input_ids=torch.from_numpy(ids.astype(np.int64)),
+            token_type_ids=torch.from_numpy(types.astype(np.int64)),
+        )
+    cfg = bert_config_from_hf(HF_CFG, attn_impl="reference")
+    params = hf_bert_state_to_params(hf_model.state_dict(), cfg)
+    seq_out, pooled = BertEncoder(cfg).apply(
+        {"params": params},
+        jnp.asarray(ids),
+        token_type_ids=jnp.asarray(types),
+    )
+    np.testing.assert_allclose(
+        np.asarray(seq_out), out.last_hidden_state.numpy(), atol=2e-5, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), out.pooler_output.numpy(), atol=2e-5, rtol=1e-4
+    )
+
+
+def test_forward_parity_with_padding(hf_model):
+    ids, types = _inputs(batch=2, seq=12)
+    mask = np.ones_like(ids)
+    mask[:, 8:] = 0  # last 4 positions are padding
+    with torch.no_grad():
+        out = hf_model(
+            input_ids=torch.from_numpy(ids.astype(np.int64)),
+            attention_mask=torch.from_numpy(mask.astype(np.int64)),
+            token_type_ids=torch.from_numpy(types.astype(np.int64)),
+        )
+    cfg = bert_config_from_hf(HF_CFG, attn_impl="reference")
+    params = hf_bert_state_to_params(hf_model.state_dict(), cfg)
+    seq_out, pooled = BertEncoder(cfg).apply(
+        {"params": params},
+        jnp.asarray(ids),
+        attention_mask=jnp.asarray(mask),
+        token_type_ids=jnp.asarray(types),
+    )
+    # only valid (unpadded) positions are defined outputs
+    ours = np.asarray(seq_out)[:, :8]
+    theirs = out.last_hidden_state.numpy()[:, :8]
+    np.testing.assert_allclose(ours, theirs, atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(pooled), out.pooler_output.numpy(), atol=2e-5, rtol=1e-4
+    )
+
+
+def test_prefixed_state_dict_from_downstream_model():
+    torch.manual_seed(1)
+    cfg_t = transformers.BertConfig(**HF_CFG)
+    clf = transformers.BertForSequenceClassification(cfg_t)
+    clf.eval()
+    cfg = bert_config_from_hf(HF_CFG, attn_impl="reference")
+    params = hf_bert_state_to_params(clf.state_dict(), cfg)
+    assert "layers_1" in params and "pooler" in params
+
+    ids, types = _inputs(batch=2, seq=8, seed=3)
+    with torch.no_grad():
+        hf_seq = clf.bert(
+            input_ids=torch.from_numpy(ids.astype(np.int64)),
+            token_type_ids=torch.from_numpy(types.astype(np.int64)),
+        ).last_hidden_state.numpy()
+    seq_out, _ = BertEncoder(cfg).apply(
+        {"params": params}, jnp.asarray(ids), token_type_ids=jnp.asarray(types)
+    )
+    np.testing.assert_allclose(np.asarray(seq_out), hf_seq, atol=2e-5, rtol=1e-4)
+
+
+def test_load_bert_dir_roundtrip(tmp_path, hf_model):
+    (tmp_path / "config.json").write_text(json.dumps(HF_CFG))
+    torch.save(hf_model.state_dict(), tmp_path / "pytorch_model.bin")
+    cfg, params = load_bert_dir(tmp_path, attn_impl="reference")
+    assert cfg.num_layers == 2
+    ids, types = _inputs(batch=1, seq=8)
+    seq_out, _ = BertEncoder(cfg).apply(
+        {"params": params}, jnp.asarray(ids), token_type_ids=jnp.asarray(types)
+    )
+    assert np.isfinite(np.asarray(seq_out)).all()
+    with pytest.raises(FileNotFoundError, match="config.json"):
+        load_bert_dir(tmp_path / "nope")
