@@ -319,24 +319,22 @@ class PyRecordLoader:
         self.epochs = epochs
         self._gen = self._iterate()
 
-    def _records(self) -> Iterator[np.ndarray]:
-        epoch = 0
-        while self.epochs < 0 or epoch < self.epochs:
-            index = 0
-            for path in self.files:
-                raw = np.fromfile(path, dtype=np.uint8)
-                header = raw[: _HEADER.itemsize].view(_HEADER)[0]
-                rb = int(header["record_bytes"])
-                if header["magic"] != _MAGIC or rb != self.spec.record_bytes:
-                    # same contract as the native loader: a record-size
-                    # mismatch must fail fast, never parse at wrong offsets
-                    raise OSError(f"bad header in {path}")
-                body = raw[_HEADER.itemsize :].reshape(-1, rb)
-                for rec in body:
-                    if index % self.shard_count == self.shard_index:
-                        yield rec
-                    index += 1
-            epoch += 1
+    def _epoch_records(self) -> Iterator[np.ndarray]:
+        """One epoch's worth of this shard's records, in file order."""
+        index = 0
+        for path in self.files:
+            raw = np.fromfile(path, dtype=np.uint8)
+            header = raw[: _HEADER.itemsize].view(_HEADER)[0]
+            rb = int(header["record_bytes"])
+            if header["magic"] != _MAGIC or rb != self.spec.record_bytes:
+                # same contract as the native loader: a record-size
+                # mismatch must fail fast, never parse at wrong offsets
+                raise OSError(f"bad header in {path}")
+            body = raw[_HEADER.itemsize :].reshape(-1, rb)
+            for rec in body:
+                if index % self.shard_count == self.shard_index:
+                    yield rec
+                index += 1
 
     def _iterate(self) -> Iterator[dict[str, np.ndarray]]:
         rng = np.random.RandomState(self.seed % (2**31 - 1))
@@ -351,26 +349,35 @@ class PyRecordLoader:
                 return buf
             return None
 
-        for rec in self._records():
-            if self.shuffle > 1:
-                pool.append(rec.copy())
-                if len(pool) >= self.shuffle:
-                    while len(pool) > self.shuffle // 2:
-                        pick = rng.randint(len(pool))
-                        pool[pick], pool[-1] = pool[-1], pool[pick]
-                        out = emit(pool.pop())
-                        if out is not None:
-                            yield self.spec.unpack(out, len(out))
-            else:
-                out = emit(rec.copy())
+        def drain(keep: int):
+            # Fisher-Yates-style random draws, same shape as the native
+            # loader's drain_pool (kftdata.cpp): pick, swap last into the
+            # hole, emit.
+            while len(pool) > keep:
+                pick = rng.randint(len(pool))
+                pool[pick], pool[-1] = pool[-1], pool[pick]
+                out = emit(pool.pop())
                 if out is not None:
                     yield self.spec.unpack(out, len(out))
-        while pool:
-            pick = rng.randint(len(pool))
-            pool[pick], pool[-1] = pool[-1], pool[pick]
-            out = emit(pool.pop())
-            if out is not None:
-                yield self.spec.unpack(out, len(out))
+
+        # Epochs are explicit so the pool FULLY drains at every epoch
+        # boundary — the native loader calls drain_pool(true) per epoch, so
+        # records never mix across epochs regardless of which loader
+        # make_loader picks. The partial batch (`pending`) DOES persist
+        # across epochs in both loaders.
+        epoch = 0
+        while self.epochs < 0 or epoch < self.epochs:
+            for rec in self._epoch_records():
+                if self.shuffle > 1:
+                    pool.append(rec.copy())
+                    if len(pool) >= self.shuffle:
+                        yield from drain(self.shuffle // 2)
+                else:
+                    out = emit(rec.copy())
+                    if out is not None:
+                        yield self.spec.unpack(out, len(out))
+            yield from drain(0)
+            epoch += 1
         if pending and not self.drop_remainder:
             buf = np.stack(pending)
             yield self.spec.unpack(buf, len(buf))

@@ -12,8 +12,10 @@ attention (SURVEY.md §5.7). Design per the TPU kernel playbook
 - causal blocks that are entirely in the future are skipped (predicated);
 - optional segment ids give block-diagonal masking (serving batches,
   packed sequences);
-- backward: recompute-based VJP in XLA for now (flash backward kernel is a
-  planned upgrade; forward is the serving-latency path).
+- backward: Pallas dq and dk/dv kernels (``flash_attention_bwd``) that
+  recompute the probabilities blockwise against the saved logsumexp — the
+  training path never materializes the S×S matrix. Ring attention reuses
+  the same backward entry per ring hop.
 
 Returns optionally the (max, logsumexp) residuals, which is what lets
 ``kubeflow_tpu.parallel.ring_attention`` merge partial results across ring
@@ -73,16 +75,10 @@ def _attn_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # (Bq, Bk)
 
-        mask = None
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (q_start + rows) >= (k_start + cols)
-        if qseg_ref is not None:
-            qs = qseg_ref[0, 0]  # (Bq,)
-            ks = kseg_ref[0, 0]  # (Bk,)
-            seg = qs[:, None] == ks[None, :]
-            mask = seg if mask is None else (mask & seg)
+        mask = _tile_mask(
+            iq, ik, causal=causal, block_q=block_q, block_k=block_k,
+            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+        )
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
@@ -180,6 +176,295 @@ def _flash_forward(
 
 
 # --------------------------------------------------------------------------- #
+# backward kernels
+# --------------------------------------------------------------------------- #
+#
+# Standard flash backward split: one kernel accumulates dq (kv blocks
+# innermost), one accumulates dk/dv (q blocks innermost). Both recompute the
+# probability block p = exp(s - lse) from the saved per-row logsumexp, so
+# peak live memory stays O(block_q × block_k) — never S×S.
+
+
+def _tile_mask(iq, ik, *, causal, block_q, block_k, qseg_ref, kseg_ref):
+    """(mask or None) for the (block_q, block_k) tile at (iq, ik) — the ONE
+    place the causal/segment tile masking lives; forward and backward
+    kernels must agree or gradients silently diverge."""
+    mask = None
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = (iq * block_q + rows) >= (ik * block_k + cols)
+    if qseg_ref is not None:
+        qs = qseg_ref[0, 0]  # (Bq,)
+        ks = kseg_ref[0, 0]  # (Bk,)
+        seg = qs[:, None] == ks[None, :]
+        mask = seg if mask is None else (mask & seg)
+    return mask
+
+
+def _prob_block(q, k, lse, mask, *, scale):
+    """p = exp(q·kᵀ·scale − lse), with masked entries exactly 0 and
+    fully-masked rows (lse = −inf sentinel) exactly 0 instead of overflow."""
+    s = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (Bq, Bk)
+    live = lse > NEG_INF / 2  # (Bq, 1)
+    p = jnp.exp(s - jnp.where(live, lse, 0.0))
+    p = jnp.where(live, p, 0.0)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    return p
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+    dq_ref,
+    dq_acc,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)    # (Bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)    # (Bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)    # (Bk, D)
+        do = do_ref[0, 0].astype(jnp.float32)  # (Bq, D)
+        lse = lse_ref[0, 0]                    # (Bq, 1)
+        delta = delta_ref[0, 0]                # (Bq, 1)
+        mask = _tile_mask(
+            iq, ik, causal=causal, block_q=block_q, block_k=block_k,
+            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+        )
+        p = _prob_block(q, k, lse, mask, scale=scale)
+        dp = jax.lax.dot_general(
+            do, v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (Bq, Bk)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+    dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_q_blocks: int,
+):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Causal: q blocks strictly before this kv block contribute nothing.
+    run = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)    # (Bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)    # (Bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)    # (Bk, D)
+        do = do_ref[0, 0].astype(jnp.float32)  # (Bq, D)
+        lse = lse_ref[0, 0]                    # (Bq, 1)
+        delta = delta_ref[0, 0]                # (Bq, 1)
+        mask = _tile_mask(
+            iq, ik, causal=causal, block_q=block_q, block_k=block_k,
+            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+        )
+        p = _prob_block(q, k, lse, mask, scale=scale)
+        # dv += pᵀ · do
+        dv_acc[:] += jax.lax.dot_general(
+            p, do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (Bq, Bk)
+        ds = p * (dp - delta) * scale
+        # dk += dsᵀ · q
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def flash_attention_bwd(
+    q, k, v, out, lse, dout,
+    *,
+    causal: bool,
+    scale: float | None = None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+    accum_dtype=jnp.float32,
+):
+    """Flash-attention gradients from saved residuals, fully blockwise.
+
+    ``lse`` is the forward's per-row logsumexp (B,H,Sq) — for ring attention
+    pass the globally-merged lse and out, and the returned (dq, dk, dv) are
+    this hop's partial contributions (exactly the per-shard terms of the
+    global softmax gradient). Returns float32 by default so ring hops can
+    accumulate without precision loss.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    batch, heads, sq, d = q.shape
+    _, _, skv, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if sq % block_q or skv % block_k:
+        raise ValueError(
+            f"seq lens (q={sq}, kv={skv}) must divide block sizes "
+            f"({block_q}, {block_k}); pad inputs"
+        )
+    nq, nk = sq // block_q, skv // block_k
+
+    doutf = dout.astype(jnp.float32)
+    delta = jnp.sum(doutf * out.astype(jnp.float32), axis=-1, keepdims=True)
+    lse4 = lse[..., None].astype(jnp.float32)  # (B,H,Sq,1)
+
+    has_seg = q_segment_ids is not None
+    qseg = kseg = None
+    if has_seg:
+        qseg = q_segment_ids[:, None, :]
+        kseg = kv_segment_ids[:, None, :]
+
+    def specs(order):
+        """order: 'qk' (iq=pid2, ik=pid3) or 'kq' (ik=pid2, iq=pid3)."""
+        if order == "qk":
+            qi = lambda b, h, i, j: (b, h, i, 0)
+            ki = lambda b, h, i, j: (b, h, j, 0)
+            qsi = lambda b, h, i, j: (b, 0, i)
+            ksi = lambda b, h, i, j: (b, 0, j)
+        else:
+            qi = lambda b, h, i, j: (b, h, j, 0)
+            ki = lambda b, h, i, j: (b, h, i, 0)
+            qsi = lambda b, h, i, j: (b, 0, j)
+            ksi = lambda b, h, i, j: (b, 0, i)
+        sp = [
+            pl.BlockSpec((1, 1, block_q, d), qi),   # q
+            pl.BlockSpec((1, 1, block_k, d), ki),   # k
+            pl.BlockSpec((1, 1, block_k, d), ki),   # v
+            pl.BlockSpec((1, 1, block_q, d), qi),   # dout
+            pl.BlockSpec((1, 1, block_q, 1), qi),   # lse
+            pl.BlockSpec((1, 1, block_q, 1), qi),   # delta
+        ]
+        if has_seg:
+            sp.append(pl.BlockSpec((1, 1, block_q), qsi))
+            sp.append(pl.BlockSpec((1, 1, block_k), ksi))
+        return sp
+
+    inputs = [q, k, v, dout, lse4, delta]
+    if has_seg:
+        inputs.extend([qseg, kseg])
+
+    # ---- dq ----
+    dq_impl = functools.partial(
+        _bwd_dq_kernel,
+        scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+    )
+    if has_seg:
+        def dq_kernel(q_r, k_r, v_r, do_r, l_r, d_r, qs_r, ks_r, dq_r, acc):
+            dq_impl(q_r, k_r, v_r, do_r, l_r, d_r, qs_r, ks_r, dq_r, acc)
+    else:
+        def dq_kernel(q_r, k_r, v_r, do_r, l_r, d_r, dq_r, acc):
+            dq_impl(q_r, k_r, v_r, do_r, l_r, d_r, None, None, dq_r, acc)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(batch, heads, nq, nk),
+        in_specs=specs("qk"),
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, accum_dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*inputs)
+
+    # ---- dk / dv ----
+    dkv_impl = functools.partial(
+        _bwd_dkv_kernel,
+        scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_q_blocks=nq,
+    )
+    if has_seg:
+        def dkv_kernel(q_r, k_r, v_r, do_r, l_r, d_r, qs_r, ks_r,
+                       dk_r, dv_r, dk_a, dv_a):
+            dkv_impl(q_r, k_r, v_r, do_r, l_r, d_r, qs_r, ks_r,
+                     dk_r, dv_r, dk_a, dv_a)
+    else:
+        def dkv_kernel(q_r, k_r, v_r, do_r, l_r, d_r, dk_r, dv_r, dk_a, dv_a):
+            dkv_impl(q_r, k_r, v_r, do_r, l_r, d_r, None, None,
+                     dk_r, dv_r, dk_a, dv_a)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(batch, heads, nk, nq),
+        in_specs=specs("kq"),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, accum_dtype),
+            jax.ShapeDtypeStruct(v.shape, accum_dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*inputs)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------- #
 # public API with recompute VJP
 # --------------------------------------------------------------------------- #
 
@@ -207,20 +492,15 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k_and_inter
 
 
 def _flash_bwd(causal, scale, block_q, block_k_and_interp, res, dout):
+    block_k, interpret = block_k_and_interp
     q, k, v, q_seg, kv_seg, out, lse = res
-    qf, kf, vf, doutf = (x.astype(jnp.float32) for x in (q, k, v, dout))
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
-    mask = _full_mask(q.shape, k.shape, q_seg, kv_seg, causal)
-    if mask is not None:
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])                      # (B,H,Sq,Skv)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, doutf)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", doutf, vf)
-    delta = jnp.sum(doutf * out.astype(jnp.float32), axis=-1, keepdims=True)
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf).astype(q.dtype)
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf).astype(k.dtype)
-    dv = dv.astype(v.dtype)
+    dq, dk, dv = flash_attention_bwd(
+        q, k, v, out, lse, dout,
+        causal=causal, scale=scale,
+        q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    dq, dk, dv = dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
     # integer segment ids carry symbolic-zero (float0) cotangents
     zseg = lambda s: None if s is None else np.zeros(s.shape, jax.dtypes.float0)
     return dq, dk, dv, zseg(q_seg), zseg(kv_seg)

@@ -272,16 +272,28 @@ def kind_env(
     ranks = job.global_ranks()
     rank = ranks[(rtype, index)]
     world = job.total_replicas
+
     # The rank-0 worker's dedicated service port doubles as the framework
     # rendezvous port (c10d store / rabit tracker) — a real allocated port,
-    # never a guessed offset off the jax coordinator's.
-    rank0_type = job.replica_order()[0]
-    master_port = service_ports[f"{rank0_type}-0"]
+    # never a guessed offset off the jax coordinator's. Resolved lazily so
+    # kinds that never use it (MPIJob hostfile path) work with empty
+    # service_ports.
+    def master_port() -> int:
+        rank0_type = job.replica_order()[0]
+        key = f"{rank0_type}-0"
+        if key not in service_ports:
+            raise KeyError(
+                f"{job.kind} rendezvous needs service_ports[{key!r}] "
+                "(the rank-0 worker's allocated port); pass service_ports "
+                "to build_worker_env for this kind"
+            )
+        return service_ports[key]
 
     if job.kind == "PyTorchJob":
+        port = str(master_port())
         return {
             "MASTER_ADDR": host,
-            "MASTER_PORT": str(master_port),
+            "MASTER_PORT": port,
             "WORLD_SIZE": str(world),
             "RANK": str(rank),
             "LOCAL_RANK": "0",
@@ -290,7 +302,7 @@ def kind_env(
             "PET_NODE_RANK": str(rank),
             "PET_NPROC_PER_NODE": "1",
             "PET_MASTER_ADDR": host,
-            "PET_MASTER_PORT": str(master_port),
+            "PET_MASTER_PORT": port,
         }
 
     if job.kind == "TFJob":
@@ -328,16 +340,17 @@ def kind_env(
         }
 
     if job.kind == "XGBoostJob":
-        # rabit tracker on the coordinator replica (SURVEY.md §2.1 "DMLC_*")
-        n_workers = sum(
-            r.replicas for rt, r in job.replicas.items() if rt != "master"
-        )
+        # rabit tracker on the coordinator replica (SURVEY.md §2.1 "DMLC_*").
+        # Upstream xgboost-operator contract: DMLC_NUM_WORKER counts every
+        # replica (master included) so global-rank task ids stay in
+        # 0..NUM_WORKER-1, and the master group's role is 'master' (the
+        # ps-lite 'server' role is a different dmlc convention).
         return {
             "DMLC_TRACKER_URI": host,
-            "DMLC_TRACKER_PORT": str(master_port),
+            "DMLC_TRACKER_PORT": str(master_port()),
             "DMLC_TASK_ID": str(rank),
-            "DMLC_NUM_WORKER": str(n_workers or world),
-            "DMLC_ROLE": "server" if rtype == "master" else "worker",
+            "DMLC_NUM_WORKER": str(world),
+            "DMLC_ROLE": "master" if rtype == "master" else "worker",
         }
 
     if job.kind == "PaddleJob":

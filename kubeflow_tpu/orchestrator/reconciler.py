@@ -339,22 +339,33 @@ class JobController:
         policy = job.spec.elastic
         replicas = policy.clamp(replicas)
         rtype = policy.replica_type
-        current = (
-            job.resize_to
-            if job.resize_to is not None
-            else job.spec.replicas[rtype].replicas
-        )
-        if replicas == current:
-            return replicas
+        changed = False
 
-        # Not a failure: scaling doesn't consume backoff budget.
-        job.status.push(
-            CT.RESTARTING, reason="Scaled",
-            message=f"{rtype} resizing to {replicas}; gang re-forming",
-        )
-        job.resize_to = replicas
-        self.jobs.update(uid, job)
-        logger.info("job %s scaling %s to %d replicas", job.spec.name, rtype, replicas)
+        # Read-modify-write under the store lock: the reconcile thread's
+        # _apply_resize clears resize_to under the same lock, so a target
+        # recorded here can never be clobbered by an in-flight teardown.
+        def _record(j: JobObject) -> None:
+            nonlocal changed
+            current = (
+                j.resize_to
+                if j.resize_to is not None
+                else j.spec.replicas[rtype].replicas
+            )
+            if replicas == current:
+                return
+            # Not a failure: scaling doesn't consume backoff budget.
+            j.status.push(
+                CT.RESTARTING, reason="Scaled",
+                message=f"{rtype} resizing to {replicas}; gang re-forming",
+            )
+            j.resize_to = replicas
+            changed = True
+
+        self.jobs.mutate(uid, _record)
+        if changed:
+            logger.info(
+                "job %s scaling %s to %d replicas", job.spec.name, rtype, replicas
+            )
         return replicas
 
     def _apply_resize(self, job: JobObject) -> None:
@@ -366,8 +377,11 @@ class JobController:
 
         uid = job.spec.uid
         rtype = job.spec.elastic.replica_type
+        # Capture the target once: scale() may record a NEWER target while
+        # the teardown below (_wait_dead can take seconds) is in flight.
+        target = job.resize_to
         job.spec.replicas[rtype] = dataclasses.replace(
-            job.spec.replicas[rtype], replicas=job.resize_to
+            job.spec.replicas[rtype], replicas=target
         )
         ws = [w for _, w in self.workers.list(prefix=f"{uid}/")]
         for w in ws:
@@ -382,11 +396,18 @@ class JobController:
                 self.launcher.workdir(uid), w.replica_type, w.index
             ).unlink(missing_ok=True)
         self.scheduler.cancel(uid)
-        job.resize_to = None
-        # Force full rewiring at the new size on the next sync.
-        job.coordinator_port = 0
-        job.service_ports = {}
-        self.jobs.update(uid, job)
+
+        def _finish(j: JobObject) -> None:
+            # Clear only if no newer target was recorded mid-teardown —
+            # otherwise leave it set so the next sync applies the new size
+            # (the gang is already down; its kills become no-ops).
+            if j.resize_to == target:
+                j.resize_to = None
+            # Force full rewiring at the new size on the next sync.
+            j.coordinator_port = 0
+            j.service_ports = {}
+
+        self.jobs.mutate(uid, _finish)
 
     def _rank0_worker(
         self, spec: JobSpec, ws: list[WorkerStatus]

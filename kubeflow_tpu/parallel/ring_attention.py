@@ -28,6 +28,7 @@ from kubeflow_tpu.core.mesh import Axis
 from kubeflow_tpu.ops.flash_attention import (
     NEG_INF,
     flash_attention,
+    flash_attention_bwd,
     reference_attention,
 )
 
@@ -38,23 +39,21 @@ def _rotate(x, axis_name: str):
     return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
 
 
-def _block_flash(q, k, v, *, causal_mode: int, scale, block_q, block_k, interpret):
-    """Partial attention of local q vs one kv shard.
+def _block_flash(q, k, v, *, step: int, src, me, causal, scale,
+                 block_q, block_k, interpret):
+    """Partial attention of local q vs the kv shard currently held (from
+    ring rank ``src``). Returns (out, lse).
 
-    causal_mode: 0 = full (kv strictly past), 1 = causal diagonal block,
-    2 = skip (kv strictly future). Returns (out, lse)."""
+    ``step`` is a Python int (the ring loop is unrolled), so the causal
+    structure resolves statically where possible: step 0 always holds the
+    home shard (src == me → diagonal block); later steps are never
+    diagonal, leaving one traced full-vs-skip choice. This keeps each hop
+    to a single flash kernel instead of tracing all three branches."""
     B, H, S, D = q.shape
 
     def full(_):
         return flash_attention(
             q, k, v, causal=False, scale=scale,
-            block_q=block_q, block_k=block_k,
-            interpret=interpret, return_residuals=True,
-        )
-
-    def diag(_):
-        return flash_attention(
-            q, k, v, causal=True, scale=scale,
             block_q=block_q, block_k=block_k,
             interpret=interpret, return_residuals=True,
         )
@@ -65,7 +64,15 @@ def _block_flash(q, k, v, *, causal_mode: int, scale, block_q, block_k, interpre
             jnp.full((B, H, S), NEG_INF, jnp.float32),
         )
 
-    return lax.switch(causal_mode, (full, diag, skip), None)
+    if not causal:
+        return full(None)
+    if step == 0:
+        return flash_attention(
+            q, k, v, causal=True, scale=scale,
+            block_q=block_q, block_k=block_k,
+            interpret=interpret, return_residuals=True,
+        )
+    return lax.cond(src < me, full, skip, None)
 
 
 def _merge(o, lse, o_t, lse_t):
@@ -84,12 +91,8 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale, block_q, block_k, interpre
     lse = jnp.full((B, H, S), NEG_INF, jnp.float32)
     for step in range(n):
         src = (me - step) % n  # whose kv shard we currently hold
-        if causal:
-            mode = jnp.where(src == me, 1, jnp.where(src < me, 0, 2))
-        else:
-            mode = jnp.int32(0)
         o_t, lse_t = _block_flash(
-            q, k, v, causal_mode=mode, scale=scale,
+            q, k, v, step=step, src=src, me=me, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k, interpret=interpret,
         )
         o, lse = _merge(o, lse, o_t, lse_t)
@@ -119,44 +122,51 @@ def _ring_local_fwd(q, k, v, axis_name, causal, scale, blocks, interpret):
 
 
 def _ring_local_bwd(axis_name, causal, scale, blocks, interpret, res, do):
-    del blocks, interpret  # bwd blocks are whole-shard einsums
+    """Second ring sweep reusing the Pallas backward kernel per hop.
+
+    The forward saved the GLOBAL (merged) out/lse, so each hop's
+    ``flash_attention_bwd`` — probabilities normalized against the global
+    lse — yields exactly that kv shard's partial terms of the global
+    softmax gradient. Peak memory per hop is O(block_q × block_k), same as
+    the forward; the whole-shard S×S matrix is never built.
+    """
+    block_q, block_k = blocks
     q, k, v, o, lse = res
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
 
-    qf = q.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (B,H,S)
-
-    dq = jnp.zeros_like(qf)
+    dq = jnp.zeros_like(q, dtype=jnp.float32)
     dk = jnp.zeros_like(k, dtype=jnp.float32)  # rides the ring with k,v
     dv = jnp.zeros_like(v, dtype=jnp.float32)
 
-    S = q.shape[2]
-    rows = lax.broadcasted_iota(jnp.int32, (S, S), 0)
-    cols = lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    def hop(step, src, k, v):
+        # mirrors _block_flash's static structure: step 0 = diagonal,
+        # later causal steps = traced full-vs-skip, non-causal = full
+        def bwd(hop_causal):
+            return flash_attention_bwd(
+                q, k, v, o, lse, do, causal=hop_causal, scale=scale,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+
+        def skip(_):
+            return (
+                jnp.zeros_like(q, dtype=jnp.float32),
+                jnp.zeros_like(k, dtype=jnp.float32),
+                jnp.zeros_like(v, dtype=jnp.float32),
+            )
+
+        if not causal:
+            return bwd(False)
+        if step == 0:
+            return bwd(True)
+        return lax.cond(src < me, lambda _: bwd(False), skip, None)
 
     for step in range(n):
-        src = (me - step) % n
-        kf = k.astype(jnp.float32)
-        vf = v.astype(jnp.float32)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
-        if causal:
-            # global causal structure between my q shard and kv shard `src`
-            keep_full = src < me
-            keep_diag = src == me
-            mask = jnp.where(
-                keep_full,
-                jnp.ones((S, S), bool),
-                jnp.where(keep_diag, rows >= cols, jnp.zeros((S, S), bool)),
-            )
-            s = jnp.where(mask[None, None], s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # (B,H,Sq,Skv) — normalized probs
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        src = (me - step) % n  # whose kv shard we currently hold
+        dq_t, dk_t, dv_t = hop(step, src, k, v)
+        dq = dq + dq_t
+        dk = dk + dk_t
+        dv = dv + dv_t
         if step != n - 1:
             k = _rotate(k, axis_name)
             v = _rotate(v, axis_name)

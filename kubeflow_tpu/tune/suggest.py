@@ -344,7 +344,16 @@ class PBTSuggester(Suggester):
                 q[p.name] = p.from_unit(self.rng.random())
                 continue
             if p.type.value in ("double", "int"):
-                u = p.to_unit(v) * self.rng.choice(self.perturb_factors)
+                # Standard PBT perturbs the parameter VALUE, not its unit
+                # coordinate — a unit-space multiply pins values at the
+                # lower bound (0 × factor = 0) forever. A value of exactly
+                # 0 can't move multiplicatively either, so nudge it in unit
+                # space instead.
+                factor = self.rng.choice(self.perturb_factors)
+                if float(v) != 0.0:
+                    u = p.to_unit(float(v) * factor)
+                else:
+                    u = p.to_unit(v) + (factor - 1.0)
                 q[p.name] = p.from_unit(min(1.0, max(0.0, u)))
             else:
                 q[p.name] = v

@@ -205,6 +205,12 @@ def test_kind_env_mpi_hostfile(tmp_path):
                    service_ports=_ports(resized), workdir=str(tmp_path))
     assert len(hostfile.read_text().strip().splitlines()) == 5
 
+    # MPIJob never uses the rank-0 service port, so empty service_ports
+    # (envwire.build_worker_env's default) must not raise
+    env2 = kinds.kind_env(resized, "worker", 0, host="127.0.0.1",
+                          service_ports={}, workdir=str(tmp_path))
+    assert "OMPI_MCA_orte_default_hostfile" in env2
+
 
 def test_kind_env_xgboost_and_paddle():
     job = _mkjob("XGBoostJob", [("master", 1), ("worker", 2)])
@@ -212,9 +218,21 @@ def test_kind_env_xgboost_and_paddle():
     env = kinds.kind_env(job, "worker", 0, host="127.0.0.1",
                          service_ports=ports, workdir="/tmp")
     assert env["DMLC_TRACKER_PORT"] == str(ports["master-0"])
-    assert env["DMLC_NUM_WORKER"] == "2"
+    # upstream xgboost-operator contract: NUM_WORKER counts every replica
+    # (master included) so global-rank task ids stay in 0..NUM_WORKER-1
+    assert env["DMLC_NUM_WORKER"] == "3"
     assert env["DMLC_ROLE"] == "worker"
     assert env["DMLC_TASK_ID"] == "1"
+    # every task id must be in range; master role is 'master', not 'server'
+    ids = set()
+    for rt, n in (("master", 1), ("worker", 2)):
+        for i in range(n):
+            e = kinds.kind_env(job, rt, i, host="127.0.0.1",
+                               service_ports=ports, workdir="/tmp")
+            ids.add(int(e["DMLC_TASK_ID"]))
+            assert 0 <= int(e["DMLC_TASK_ID"]) < int(e["DMLC_NUM_WORKER"])
+            assert e["DMLC_ROLE"] == ("master" if rt == "master" else "worker")
+    assert ids == {0, 1, 2}
 
     pjob = _mkjob("PaddleJob", [("worker", 2)])
     pports = _ports(pjob)
