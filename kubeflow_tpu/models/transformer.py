@@ -59,6 +59,13 @@ class TransformerConfig:
     moe_every: int = 0               # every Nth layer uses MoE FFN (0 = never)
     moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
     dropout_rate: float = 0.0
+    # "gather" = table lookup (best single-chip/serving). "onehot" = one-hot
+    # matmul — the SPMD-clean form when the table is sharded P(model, fsdp):
+    # a sharded-vocab gather forces the partitioner into involuntary full
+    # rematerialization (replicate-then-reshard), while the one-hot
+    # contraction over vocab partitions into a plain psum over the model
+    # axis and rides the MXU.
+    embed_impl: str = "gather"
 
     @property
     def head_dim(self) -> int:
@@ -87,6 +94,34 @@ def _act_constraint(x: jax.Array, *, seq_dim: int = 1) -> jax.Array:
     spec[0] = (Axis.DATA, Axis.FSDP)
     spec[seq_dim] = Axis.SEQ
     return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+class Embedding(nn.Module):
+    """Token embedding with a choice of lookup implementation.
+
+    Param path matches ``nn.Embed`` ("embedding", same default init), so
+    checkpoints and sharding rules are interchangeable. ``impl="onehot"``
+    trades a gather for an MXU one-hot contraction — required for clean
+    SPMD partitioning when the table is sharded P(model, fsdp); see
+    ``TransformerConfig.embed_impl``.
+    """
+
+    vocab_size: int
+    features: int
+    dtype: Any = jnp.float32
+    impl: str = "gather"
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        table = self.param(
+            "embedding",
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal", out_axis=0),
+            (self.vocab_size, self.features),
+        )
+        if self.impl == "onehot":
+            oh = jax.nn.one_hot(tokens, self.vocab_size, dtype=self.dtype)
+            return oh @ table.astype(self.dtype)
+        return jnp.take(table, tokens, axis=0).astype(self.dtype)
 
 
 def rope(x: jax.Array, positions: jax.Array, *, base: float = 10_000.0) -> jax.Array:
@@ -283,9 +318,9 @@ class TransformerLM(nn.Module):
         B, S = tokens.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-        x = nn.Embed(
+        x = Embedding(
             cfg.vocab_size, cfg.d_model,
-            dtype=cfg.dtype, name="embed",
+            dtype=cfg.dtype, impl=cfg.embed_impl, name="embed",
         )(tokens)
         if not cfg.use_rope:
             pos_emb = self.param(

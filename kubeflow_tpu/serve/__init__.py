@@ -7,6 +7,10 @@ Layout mirrors the reference's separation of concerns:
                    bucket-batched jitted forward (no ragged-shape recompiles).
 - ``protocol``   — v1 (``:predict``) and v2 / Open Inference codecs.
 - ``server``     — aiohttp ``ModelServer`` + ``DataPlane`` registry.
+- ``grpc_server``— Open Inference gRPC servicer/client over the same
+                   ``DataPlane`` (protoc-generated messages, wire-compatible
+                   with stock v2 clients).
+- ``tokenizer``  — WordPiece from vocab.txt (the kserve-bert data path).
 - ``batcher``    — request batching (max batch size / max latency).
 - ``logger``     — CloudEvents-style request/response logging.
 - ``storage``    — storage-initializer (``file://``, ``gs://`` stub) → local dir.

@@ -1,0 +1,296 @@
+"""gRPC v2 (Open Inference Protocol) servicer over the shared DataPlane.
+
+Reference analog: [kserve] python/kserve/kserve/protocol/grpc/servicer.py
+(UNVERIFIED, mount empty — SURVEY.md §0). The same ``DataPlane`` answers
+REST (serve/server.py) and gRPC, so an infer request gives identical
+results over either transport — asserted by tests/test_grpc.py.
+
+This image has grpcio + protoc but no grpc python plugin, so the service is
+registered through ``grpc.method_handlers_generic_handler`` with protobuf
+(de)serializers from the protoc-generated ``open_inference_pb2`` — the wire
+format IS the published Open Inference gRPC protocol; stock v2 clients
+(tritonclient, kserve InferenceGRPCClient) interoperate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent import futures
+from typing import Any
+
+import grpc
+import numpy as np
+
+from kubeflow_tpu.serve.protocol import _NP_TO_V2, _V2_TO_NP
+from kubeflow_tpu.serve.protos import open_inference_pb2 as pb
+from kubeflow_tpu.serve.server import DataPlane
+
+SERVICE = "inference.GRPCInferenceService"
+
+# datatype → InferTensorContents field holding it
+_CONTENTS_FIELD = {
+    "BOOL": "bool_contents",
+    "INT8": "int_contents",
+    "INT16": "int_contents",
+    "INT32": "int_contents",
+    "INT64": "int64_contents",
+    "UINT8": "uint_contents",
+    "UINT16": "uint_contents",
+    "UINT32": "uint_contents",
+    "UINT64": "uint64_contents",
+    "FP32": "fp32_contents",
+    "FP64": "fp64_contents",
+    "BYTES": "bytes_contents",
+}
+
+
+def decode_input_tensor(
+    t: "pb.ModelInferRequest.InferInputTensor", raw: bytes | None
+) -> np.ndarray:
+    """One InferInputTensor (+ optional raw content) → numpy array."""
+    dt = t.datatype.upper()
+    shape = tuple(t.shape)
+    if raw:
+        if dt == "BF16":
+            return np.frombuffer(raw, np.uint16).reshape(shape)
+        if dt == "BYTES":
+            # spec framing: each element is u32-LE length + payload
+            items, off = [], 0
+            while off + 4 <= len(raw):
+                n = int.from_bytes(raw[off : off + 4], "little")
+                off += 4
+                items.append(raw[off : off + n])
+                off += n
+            return np.asarray(items, np.object_).reshape(shape)
+        return np.frombuffer(raw, _V2_TO_NP[dt]).reshape(shape)
+    field = _CONTENTS_FIELD.get(dt)
+    if field is None:
+        raise ValueError(f"unsupported datatype {t.datatype!r}")
+    vals = list(getattr(t.contents, field))
+    if dt == "BYTES":
+        return np.asarray(vals, np.object_).reshape(shape)
+    return np.asarray(vals, _V2_TO_NP[dt]).reshape(shape)
+
+
+def encode_output_tensor(
+    name: str, arr: np.ndarray
+) -> tuple["pb.ModelInferResponse.InferOutputTensor", bytes | None]:
+    """→ (tensor, raw_bytes). FP16/BF16 have no InferTensorContents field in
+    the public spec, so they travel in raw_output_contents."""
+    arr = np.asarray(arr)
+    dt = _NP_TO_V2.get(arr.dtype.name, "FP32")
+    out = pb.ModelInferResponse.InferOutputTensor(
+        name=name, datatype=dt, shape=list(arr.shape)
+    )
+    if dt in ("FP16", "BF16"):
+        return out, np.ascontiguousarray(arr).tobytes()
+    flat = arr.reshape(-1)
+    if arr.dtype.name not in _NP_TO_V2:
+        flat = flat.astype(np.float32)
+    getattr(out.contents, _CONTENTS_FIELD[dt]).extend(flat.tolist())
+    return out, None
+
+
+class GrpcInferenceServer:
+    """Open-Inference gRPC endpoint over an existing ``DataPlane``.
+
+    The DataPlane's infer path is async (the batcher lives on an event
+    loop); gRPC handlers run on grpc's thread pool, so coroutines are
+    submitted to ``loop``. When the DataPlane is shared with an HTTP server
+    (ModelServer) the SAME loop must be passed — a Batcher coalesces
+    requests into futures bound to the loop they were created on, and
+    completing a future from a different loop never wakes its waiter
+    (cross-loop deadlock). Standalone use (no ``loop``) gets a dedicated
+    background loop owned by this server.
+    """
+
+    def __init__(
+        self,
+        dataplane: DataPlane,
+        *,
+        port: int = 8081,
+        loop: asyncio.AbstractEventLoop | None = None,
+    ):
+        self.dataplane = dataplane
+        self.port = port
+        self._server: grpc.Server | None = None
+        self._owns_loop = loop is None
+        self._loop = loop if loop is not None else asyncio.new_event_loop()
+        self._loop_thread = (
+            threading.Thread(
+                target=self._loop.run_forever, name="grpc-infer-loop", daemon=True
+            )
+            if self._owns_loop
+            else None
+        )
+
+    # -- RPC bodies --------------------------------------------------------- #
+
+    def _run(self, coro) -> Any:
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
+
+    def server_live(self, req, ctx):
+        return pb.ServerLiveResponse(live=True)
+
+    def server_ready(self, req, ctx):
+        names = self.dataplane.list_models()
+        ready = all(self.dataplane.get(n).ready for n in names)
+        return pb.ServerReadyResponse(ready=ready)
+
+    def model_ready(self, req, ctx):
+        # DataPlane.get raises aiohttp HTTPNotFound; map to grpc NOT_FOUND
+        try:
+            m = self.dataplane.get(req.name)
+        except Exception:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, f"model {req.name!r} not found")
+        return pb.ModelReadyResponse(ready=m.ready)
+
+    def server_metadata(self, req, ctx):
+        return pb.ServerMetadataResponse(
+            name="kubeflow-tpu", version="2", extensions=[]
+        )
+
+    def model_metadata(self, req, ctx):
+        try:
+            m = self.dataplane.get(req.name)
+        except Exception:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, f"model {req.name!r} not found")
+        return pb.ModelMetadataResponse(name=m.name, platform="jax-tpu")
+
+    def model_infer(self, req: "pb.ModelInferRequest", ctx):
+        try:
+            tensors: dict[str, np.ndarray] = {}
+            for i, t in enumerate(req.inputs):
+                raw = (
+                    req.raw_input_contents[i]
+                    if i < len(req.raw_input_contents)
+                    else None
+                )
+                tensors[t.name] = decode_input_tensor(t, raw)
+            if not tensors:
+                raise ValueError("infer request has no input tensors")
+        except ValueError as e:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        # same payload mapping as the REST v2 endpoint (server.py _v2_infer)
+        ids = tensors.get("input_ids")
+        payload = {
+            "instances": (
+                ids if ids is not None else next(iter(tensors.values()))
+            ).tolist()
+        }
+        from aiohttp import web
+
+        try:
+            result = self._run(self.dataplane.infer(req.model_name, payload))
+        except web.HTTPNotFound:
+            ctx.abort(
+                grpc.StatusCode.NOT_FOUND, f"model {req.model_name!r} not found"
+            )
+        except web.HTTPServiceUnavailable as e:
+            ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e.reason))
+        except ValueError as e:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        preds = result["predictions"] if isinstance(result, dict) else result
+        resp = pb.ModelInferResponse(model_name=req.model_name, id=req.id)
+        tensor, raw = encode_output_tensor("output_0", np.asarray(preds))
+        resp.outputs.append(tensor)
+        if raw is not None:
+            resp.raw_output_contents.append(raw)
+        return resp
+
+    # -- grpc plumbing ------------------------------------------------------ #
+
+    def handler(self) -> grpc.GenericRpcHandler:
+        def unary(fn, req_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        return grpc.method_handlers_generic_handler(
+            SERVICE,
+            {
+                "ServerLive": unary(self.server_live, pb.ServerLiveRequest),
+                "ServerReady": unary(self.server_ready, pb.ServerReadyRequest),
+                "ModelReady": unary(self.model_ready, pb.ModelReadyRequest),
+                "ServerMetadata": unary(
+                    self.server_metadata, pb.ServerMetadataRequest
+                ),
+                "ModelMetadata": unary(
+                    self.model_metadata, pb.ModelMetadataRequest
+                ),
+                "ModelInfer": unary(self.model_infer, pb.ModelInferRequest),
+            },
+        )
+
+    def start(self) -> int:
+        """Bind and serve; returns the bound port (0 → ephemeral)."""
+        if self._loop_thread is not None:
+            self._loop_thread.start()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers((self.handler(),))
+        self.port = self._server.add_insecure_port(f"[::]:{self.port}")
+        self._server.start()
+        return self.port
+
+    def stop(self, grace: float = 0.5) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+        if self._owns_loop:
+            if self._loop_thread is not None and self._loop_thread.is_alive():
+                self._loop.call_soon_threadsafe(self._loop.stop)
+                self._loop_thread.join(timeout=5)
+            self._loop.close()
+
+
+class GrpcInferenceClient:
+    """Minimal Open-Inference gRPC client (tests, examples, benchmarks)."""
+
+    def __init__(self, address: str):
+        self._channel = grpc.insecure_channel(address)
+
+    def _call(self, method: str, request, resp_cls):
+        return self._channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )(request)
+
+    def server_ready(self) -> bool:
+        return self._call(
+            "ServerReady", pb.ServerReadyRequest(), pb.ServerReadyResponse
+        ).ready
+
+    def model_ready(self, name: str) -> bool:
+        return self._call(
+            "ModelReady", pb.ModelReadyRequest(name=name), pb.ModelReadyResponse
+        ).ready
+
+    def infer(
+        self, model_name: str, inputs: dict[str, np.ndarray]
+    ) -> dict[str, np.ndarray]:
+        req = pb.ModelInferRequest(model_name=model_name)
+        for name, arr in inputs.items():
+            arr = np.asarray(arr)
+            t = req.inputs.add()
+            t.name = name
+            t.datatype = _NP_TO_V2.get(arr.dtype.name, "FP32")
+            t.shape.extend(arr.shape)
+            field = _CONTENTS_FIELD[t.datatype]
+            getattr(t.contents, field).extend(arr.reshape(-1).tolist())
+        resp = self._call("ModelInfer", req, pb.ModelInferResponse)
+        out = {}
+        for i, t in enumerate(resp.outputs):
+            raw = (
+                resp.raw_output_contents[i]
+                if i < len(resp.raw_output_contents)
+                else None
+            )
+            out[t.name] = decode_input_tensor(t, raw)
+        return out
+
+    def close(self) -> None:
+        self._channel.close()

@@ -10,10 +10,16 @@ shapes.
 ``storage_path`` resolution order (the /mnt/models contract):
 1. HF-format dir (config.json + pytorch_model.bin) → converted via
    ``models.convert`` — a reference user's torch BERT checkpoint serves
-   here unchanged, numerically identical;
+   here unchanged, numerically identical; its ``vocab.txt`` drives the
+   real WordPiece tokenizer so token ids match the training vocab;
 2. Orbax checkpoint directory → restored;
-3. otherwise random weights at the configured size (perf-identical for
-   latency benchmarks; no egress ⇒ no downloads).
+3. no storage_path at all → random weights at the configured size
+   (perf-identical for latency benchmarks; no egress ⇒ no downloads).
+
+Loading is FAIL-CLOSED: a storage_path that exists but cannot be loaded
+raises (the server never reports ready over garbage weights — serving
+fresh-random weights from a corrupt checkpoint is the one thing a model
+server must not do).
 """
 
 from __future__ import annotations
@@ -105,7 +111,26 @@ class BertRuntimeModel(JAXModel):
             cfg = bert_base()
         model = BertForMaskedLM(cfg)
         self.config = cfg
-        self.tokenizer = SimpleTokenizer(cfg.vocab_size)
+        vocab_file = (
+            os.path.join(storage_path, "vocab.txt") if storage_path else None
+        )
+        if vocab_file and os.path.isfile(vocab_file):
+            from kubeflow_tpu.serve.tokenizer import WordPieceTokenizer
+
+            # Casing comes from the checkpoint's own tokenizer_config.json
+            # (the HF contract); default True matches bert-base-uncased.
+            # Vocab-size heuristics are NOT reliable (multilingual-cased etc).
+            lower = True
+            tok_cfg = os.path.join(storage_path, "tokenizer_config.json")
+            if os.path.isfile(tok_cfg):
+                import json
+
+                lower = bool(
+                    json.loads(open(tok_cfg).read()).get("do_lower_case", True)
+                )
+            self.tokenizer = WordPieceTokenizer(vocab_file, do_lower_case=lower)
+        else:
+            self.tokenizer = SimpleTokenizer(cfg.vocab_size)
         self._storage_path = storage_path
 
         def init_params():
@@ -119,15 +144,27 @@ class BertRuntimeModel(JAXModel):
                 # checkpoint pieces win; anything it lacks (e.g. an MLM head
                 # absent from a bare BertModel dump) keeps the fresh init
                 return _deep_merge(fresh, converted)
-            if storage_path and os.path.isdir(storage_path) and os.listdir(storage_path):
-                import orbax.checkpoint as ocp
+            if storage_path is None:
+                return fresh  # explicit fresh-weights serving (benchmarks)
+            # Fail closed on EVERYTHING else: a missing mount, an empty dir,
+            # or an unloadable checkpoint must surface through readiness —
+            # never silently serve random weights.
+            if not (os.path.isdir(storage_path) and os.listdir(storage_path)):
+                raise RuntimeError(
+                    f"model {name!r}: storage_path {storage_path!r} is "
+                    "missing or empty (failed mount / wrong path?)"
+                )
+            import orbax.checkpoint as ocp
 
-                try:
-                    with ocp.StandardCheckpointer() as ckptr:
-                        return ckptr.restore(os.path.abspath(storage_path))
-                except Exception:
-                    pass  # fall through to random init (fresh-weights serving)
-            return fresh
+            try:
+                with ocp.StandardCheckpointer() as ckptr:
+                    return ckptr.restore(os.path.abspath(storage_path))
+            except Exception as e:
+                raise RuntimeError(
+                    f"model {name!r}: storage_path {storage_path!r} is "
+                    "neither an HF-format dir nor a restorable Orbax "
+                    f"checkpoint: {e}"
+                ) from e
 
         def apply_fn(params, input_ids, attention_mask):
             return model.apply(

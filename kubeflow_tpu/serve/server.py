@@ -122,15 +122,18 @@ class ModelServer:
         models: list[Model] | None = None,
         *,
         http_port: int = 8080,
+        grpc_port: int | None = None,
         logger: RequestLogger | None = None,
         batcher: BatcherConfig | None = None,
     ):
         self.http_port = http_port
+        self.grpc_port = grpc_port
         self.dataplane = DataPlane(logger=logger)
         self._batcher_cfg = batcher
         for m in models or []:
             self.register(m)
         self._runner: web.AppRunner | None = None
+        self._grpc = None
 
     def register(self, model: Model) -> None:
         if not model.ready:
@@ -228,8 +231,24 @@ class ModelServer:
         await self._runner.setup()
         site = web.TCPSite(self._runner, "0.0.0.0", self.http_port)
         await site.start()
+        if self.grpc_port is not None:
+            # same DataPlane answers both transports (v2 protocol parity);
+            # MUST share this loop or a shared Batcher deadlocks cross-loop
+            import asyncio
+
+            from kubeflow_tpu.serve.grpc_server import GrpcInferenceServer
+
+            self._grpc = GrpcInferenceServer(
+                self.dataplane,
+                port=self.grpc_port,
+                loop=asyncio.get_running_loop(),
+            )
+            self.grpc_port = self._grpc.start()
 
     async def stop_async(self) -> None:
+        if self._grpc is not None:
+            self._grpc.stop()
+            self._grpc = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

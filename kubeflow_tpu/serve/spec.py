@@ -77,6 +77,59 @@ class InferenceServiceSpec:
         if p.model_format is None and p.runtime is None:
             raise ValueError("predictor needs model_format or explicit runtime")
 
+    @classmethod
+    def from_manifest(cls, manifest: Mapping[str, Any]) -> "InferenceServiceSpec":
+        """Reference-style InferenceService manifest → spec.
+
+        Accepts the KServe v1beta1 shape: ``spec.predictor.model`` with
+        ``modelFormat.name`` / ``storageUri`` / ``runtime``, replica bounds,
+        ``canaryTrafficPercent``; optional transformer/explainer components.
+        """
+        if manifest.get("kind", "InferenceService") != "InferenceService":
+            raise ValueError(f"not an InferenceService: {manifest.get('kind')!r}")
+        meta = manifest.get("metadata", {})
+        spec = manifest.get("spec", {})
+
+        def component(d: Mapping[str, Any], klass):
+            model = d.get("model", d)
+            fmt = model.get("modelFormat")
+            if isinstance(fmt, Mapping):
+                fmt = fmt.get("name")
+            kw = dict(
+                model_format=fmt,
+                storage_uri=model.get("storageUri"),
+                runtime=model.get("runtime"),
+                min_replicas=int(d.get("minReplicas", 1)),
+                max_replicas=int(d.get("maxReplicas", max(1, int(d.get("minReplicas", 1))))),
+                scale_target=int(d.get("scaleTarget", 1)),
+            )
+            if klass is PredictorSpec:
+                kw["canary_traffic_percent"] = int(
+                    d.get("canaryTrafficPercent", 100)
+                )
+            return klass(**kw)
+
+        pred = spec.get("predictor")
+        if not pred:
+            raise ValueError("InferenceService manifest has no spec.predictor")
+        out = cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            predictor=component(pred, PredictorSpec),
+            transformer=(
+                component(spec["transformer"], ComponentSpec)
+                if spec.get("transformer")
+                else None
+            ),
+            explainer=(
+                component(spec["explainer"], ComponentSpec)
+                if spec.get("explainer")
+                else None
+            ),
+        )
+        out.validate()
+        return out
+
 
 class RuntimeRegistry:
     """ClusterServingRuntime lookup: format → highest-priority runtime."""

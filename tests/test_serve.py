@@ -397,6 +397,27 @@ def test_isvc_validate_and_runtime_resolution():
         reg.resolve(ComponentSpec(model_format="onnx"))
 
 
+def test_isvc_from_manifest():
+    import yaml
+    from pathlib import Path
+
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "kubeflow_tpu" / "examples" / "manifests" / "bert_isvc.yaml"
+    )
+    spec = InferenceServiceSpec.from_manifest(yaml.safe_load(path.read_text()))
+    assert spec.name == "bert"
+    assert spec.predictor.model_format == "huggingface"
+    assert spec.predictor.storage_uri == "file:///mnt/models/bert-base-uncased"
+    assert spec.predictor.max_replicas == 2
+    assert spec.transformer is None
+
+    with pytest.raises(ValueError, match="predictor"):
+        InferenceServiceSpec.from_manifest(
+            {"kind": "InferenceService", "metadata": {"name": "x"}, "spec": {}}
+        )
+
+
 def test_isvc_controller_deploy_and_canary(tmp_path):
     ctl = InferenceServiceController(_echo_registry(), model_dir=str(tmp_path))
     st = ctl.apply(InferenceServiceSpec("svc", PredictorSpec(model_format="echo")))

@@ -167,3 +167,13 @@ def test_bidirectional_encoder_mode(tokens):
     toks2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % VOCAB)
     logits2 = model.apply({"params": params}, toks2)
     assert not np.allclose(np.asarray(logits[:, 0]), np.asarray(logits2[:, 0]))
+
+
+def test_embed_onehot_matches_gather(ref_setup, tokens):
+    # same params, same numbers — onehot is the SPMD-clean lookup form
+    params, ref_logits = ref_setup
+    model = TransformerLM(_cfg(embed_impl="onehot"))
+    logits = model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), atol=1e-5
+    )
