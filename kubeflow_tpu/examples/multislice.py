@@ -1,0 +1,129 @@
+"""Multislice worker — the DCN story (SURVEY.md §2.7 "DCN" row, §7 hard
+part 6).
+
+Each jax.distributed process stands in for one TPU slice: the mesh gets a
+leading ``dcn_data`` axis equal to the process count, data parallelism runs
+ACROSS slices (over DCN) while tensor/FSDP parallelism stays WITHIN a slice
+(over ICI) — the placement the scaling playbook prescribes, since DCN is
+orders of magnitude thinner than ICI.
+
+The script asserts the placement (every DCN block of the mesh contains
+exactly one process's devices), runs a cross-slice psum, then trains the
+transformer for a few steps. Run under the orchestrator as a JAXJob with
+N workers, or standalone in one process (dcn_data=1).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--log-every", type=int, default=2)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.core.distributed import initialize_from_env
+
+    initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    print(
+        f"devices: {jax.local_device_count()} local / "
+        f"{jax.device_count()} global, process {jax.process_index()}"
+    )
+
+    from kubeflow_tpu.core.mesh import Axis, MeshSpec, build_mesh
+    from kubeflow_tpu.data.synthetic import TokenLMDataset, local_shard_iterator
+    from kubeflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        make_init_fn,
+        make_loss_fn,
+    )
+    from kubeflow_tpu.parallel.sharding import transformer_rules
+    from kubeflow_tpu.train.loop import TrainConfig, Trainer
+
+    n_slices = jax.process_count()
+    per_slice = jax.local_device_count()
+    # TP (model) + FSDP within the slice; DP across slices via DCN.
+    model_par = 2 if per_slice % 2 == 0 else 1
+    fsdp = per_slice // model_par
+    spec = MeshSpec(dcn_data=n_slices, fsdp=fsdp, model=model_par)
+    mesh = build_mesh(spec)
+
+    # -- placement: each DCN block must be exactly one process ---------- #
+    data_pos = Axis.ALL.index(Axis.DATA)
+    blocks = np.moveaxis(mesh.devices, data_pos, 0)
+    for i in range(n_slices):
+        procs = {d.process_index for d in blocks[i].flat}
+        assert procs == {i}, (
+            f"dcn block {i} spans processes {procs}; cross-slice traffic "
+            "would ride axes meant for ICI"
+        )
+    print(f"dcn placement ok: {n_slices} slices x {per_slice} devices")
+
+    # -- cross-slice collective ----------------------------------------- #
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @jax.jit
+    def cross_slice_sum(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P())
+        ).sum()
+
+    local = jnp.ones((n_slices * fsdp,), jnp.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P((Axis.DATA, Axis.FSDP))),
+        np.ones((fsdp,), np.float32) * (jax.process_index() + 1),
+        (n_slices * fsdp,),
+    )
+    del local
+    total = float(cross_slice_sum(arr))
+    want = sum((i + 1) * fsdp for i in range(n_slices))
+    assert total == want, (total, want)
+    print(f"cross-slice psum ok: {total}")
+
+    # -- DP-across / TP-within training --------------------------------- #
+    cfg = TransformerConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        d_ff=128,
+        attn_impl="reference",
+        dtype=jnp.float32,
+        embed_impl="onehot",
+    )
+    model = TransformerLM(cfg)
+    global_batch = 2 * spec.batch_partitions
+    trainer = Trainer(
+        init_params=make_init_fn(model, args.seq_len, spec.batch_partitions),
+        loss_fn=make_loss_fn(model),
+        optimizer=optax.adamw(1e-3),
+        config=TrainConfig(
+            mesh=spec,
+            global_batch=global_batch,
+            steps=args.steps,
+            log_every=args.log_every,
+        ),
+        param_spec_fn=transformer_rules(),
+    )
+    ds = TokenLMDataset(vocab_size=256, seq_len=args.seq_len)
+    state, history = trainer.fit(
+        lambda s: local_shard_iterator(ds, global_batch, start_step=s)
+    )
+    assert int(state.step) == args.steps
+    if jax.process_index() == 0:
+        print(f"multislice training ok: steps={int(state.step)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

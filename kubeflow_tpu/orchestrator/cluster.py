@@ -43,6 +43,7 @@ class LocalCluster:
         wiring: WiringConfig | None = None,
         *,
         base_dir: str | None = None,
+        persist_path: str | None = None,
         resync_period: float = 0.1,
         restart_backoff_base: float = 1.0,
         admission: "AdmissionChain | None" = None,
@@ -50,7 +51,29 @@ class LocalCluster:
         self.fleet = fleet or Fleet.single_host(chips=8)
         self.wiring = wiring or WiringConfig(platform="cpu_sim")
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="kft-cluster-")
-        self.jobs = ObjectStore("jobs")
+        if persist_path:
+            # etcd analog: jobs survive a control-plane restart. Worker
+            # records deliberately do NOT — they describe live processes of
+            # the dead incarnation; the reconciler re-forms each unfinished
+            # job's gang from desired state (training resumes from its own
+            # checkpoints, same shape as elastic resize).
+            from kubeflow_tpu.orchestrator.store import SqliteObjectStore
+
+            from kubeflow_tpu.orchestrator.spec import JobConditionType as CT
+
+            self.jobs = SqliteObjectStore("jobs", persist_path)
+            for uid, job in self.jobs.list():
+                if not job.status.finished:
+                    job.coordinator_port = 0
+                    job.service_ports = {}
+                    job.status.push(
+                        CT.RESTARTING,
+                        reason="ControllerRestart",
+                        message="control plane restarted; re-forming gang",
+                    )
+                    self.jobs.checkpoint(uid)
+        else:
+            self.jobs = ObjectStore("jobs")
         self.workers = ObjectStore("workers")
         self.scheduler = GangScheduler(self.fleet)
         self.launcher = ProcessLauncher(self.workers, self.base_dir)

@@ -114,6 +114,72 @@ class ObjectStore:
             q.put(Event(kind, key, obj, version))
 
 
+class SqliteObjectStore(ObjectStore):
+    """Write-through persistent ObjectStore (sqlite) — the etcd half of the
+    apiserver analog.
+
+    Reference analog: the K8s control plane survives controller restarts
+    because CRs live in etcd; Katib additionally keeps observations in
+    MySQL via the db-manager (SURVEY.md §2.3 "DB manager" row). Here every
+    ADDED/MODIFIED/DELETED is mirrored to sqlite under the store lock, and
+    a fresh process re-loads the surviving objects — the reconciler then
+    re-forms gangs from desired state (checkpoint-restart semantics, the
+    same shape as elastic resize).
+
+    In-process reference semantics are preserved: reads return the live
+    objects from memory; sqlite only matters at (re)start. Values are
+    pickled — these are our own dataclasses, not untrusted input.
+    """
+
+    def __init__(self, name: str, path: str):
+        super().__init__(name)
+        import os
+        import sqlite3
+
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS objects ("
+            " store TEXT NOT NULL, key TEXT NOT NULL, value BLOB NOT NULL,"
+            " PRIMARY KEY (store, key))"
+        )
+        self._db.commit()
+        import pickle
+
+        self._pickle = pickle
+        for key, blob in self._db.execute(
+            "SELECT key, value FROM objects WHERE store=?", (name,)
+        ).fetchall():
+            self._objects[key] = pickle.loads(blob)
+
+    def _notify(self, kind: str, key: str, obj: Any) -> None:
+        # called under self._lock by every CRUD path
+        if kind == "DELETED":
+            self._db.execute(
+                "DELETE FROM objects WHERE store=? AND key=?", (self.name, key)
+            )
+        else:
+            self._db.execute(
+                "INSERT OR REPLACE INTO objects (store, key, value)"
+                " VALUES (?,?,?)",
+                (self.name, key, self._pickle.dumps(obj)),
+            )
+        self._db.commit()
+        super()._notify(kind, key, obj)
+
+    def checkpoint(self, key: str) -> None:
+        """Persist the current in-memory state of ``key`` (for callers that
+        mutated a stored object in place without going through update)."""
+        with self._lock:
+            if key in self._objects:
+                self._notify("MODIFIED", key, self._objects[key])
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
 class Watch:
     def __init__(self, store: ObjectStore, q: queue.SimpleQueue):
         self._store = store

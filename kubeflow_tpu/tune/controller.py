@@ -26,6 +26,7 @@ import time
 from typing import Any, Callable, Mapping
 
 from kubeflow_tpu.tune import metrics as metrics_mod
+from kubeflow_tpu.tune.db import TrialDB
 from kubeflow_tpu.tune.earlystop import make_early_stopper
 from kubeflow_tpu.tune.spec import (
     ExperimentSpec,
@@ -170,6 +171,7 @@ class ExperimentController:
         *,
         suggester: Suggester | None = None,
         seed: int = 0,
+        db: "TrialDB | None" = None,
     ):
         spec.validate()
         self.spec = spec
@@ -178,6 +180,36 @@ class ExperimentController:
         self.trials: list[Trial] = []
         self._lock = threading.Lock()
         self._stopper = make_early_stopper(spec.early_stopping, spec.objective)
+        self.db = db
+        if db is not None:
+            # Resume (Katib ResumePolicy + db-manager semantics): terminal
+            # trials re-enter history/lineage with their recorded metrics;
+            # trials that were mid-flight when the previous controller died
+            # are marked KILLED — their jobs are gone, and the budget lets
+            # the suggester replace them.
+            for t in db.load_trials(spec.name):
+                if t.state in (TrialState.CREATED, TrialState.RUNNING):
+                    t.state = TrialState.KILLED
+                    t.message = "controller restarted mid-trial"
+                    db.record_trial(spec.name, t)
+                self.trials.append(t)
+
+    def _persist(self, trial: Trial) -> None:
+        if self.db is not None:
+            self.db.record_trial(self.spec.name, trial)
+            obj = self.spec.objective
+            if trial.observations:
+                # replace (don't double-append) this trial's observation log
+                have = self.db.observations(
+                    self.spec.name, trial.assignment.trial_id, obj.metric
+                )
+                if have != trial.observations:
+                    self.db.report_observations(
+                        self.spec.name,
+                        trial.assignment.trial_id,
+                        obj.metric,
+                        trial.observations[len(have):],
+                    )
 
     # -- main loop ----------------------------------------------------------
 
@@ -217,6 +249,7 @@ class ExperimentController:
                         t = Trial(assignment=a)
                         with self._lock:
                             self.trials.append(t)
+                        self._persist(t)
                         pending.add(pool.submit(self._run_one, t))
                 if not pending:
                     continue
@@ -231,6 +264,7 @@ class ExperimentController:
 
     def _run_one(self, trial: Trial) -> None:
         trial.state = TrialState.RUNNING
+        self._persist(trial)
         self.runner.run(trial, self.spec)
         if self._stopper is not None and trial.state is TrialState.SUCCEEDED:
             # retroactive medianstop: mark hopeless completed trials so the
@@ -241,6 +275,7 @@ class ExperimentController:
                 if self._stopper.should_stop(trial, others):
                     trial.state = TrialState.EARLY_STOPPED
                     self.runner.stop(trial)
+        self._persist(trial)
 
     # -- bookkeeping ---------------------------------------------------------
 
