@@ -1,39 +1,112 @@
-"""Headline benchmark — BASELINE config 1: JAXJob-vs-PyTorchJob MNIST step time.
+"""BASELINE benchmark suite — all five BASELINE.json configs, measured.
 
-Measures OUR steady-state MNIST CNN train-step time on the local accelerator
-(TPU v5e under the driver) and, for ``vs_baseline``, measures the REFERENCE
-config's data plane in-process: the same CNN trained by torch on CPU (the
-reference example runs with the gloo CPU backend — SURVEY.md §6 row 1,
-BASELINE.json configs[0]; no published numbers exist, so both sides are
-measured here).
+The reference publishes no numbers (BASELINE.md), so both sides are measured
+here: OUR side on the local accelerator (TPU v5e under the driver), the
+REFERENCE side in-process with torch-CPU / framework-native equivalents of
+each config's data plane (the reference examples run CPU/gloo in CI —
+SURVEY.md §4, §6).
 
-Prints ONE JSON line:
-    {"metric": ..., "value": <ms>, "unit": "ms", "vs_baseline": <speedup>}
+Emits one JSON line per config as it completes, then ONE final headline line
+(the driver parses the last line):
+
+    {"metric": "bert_base_train_mfu", "value": <pct>, "unit": "%",
+     "vs_baseline": <speedup>, "detail": {... all five configs ...}}
+
+Configs (BASELINE.json `configs[0..4]` / SURVEY.md §6 rows 1-5):
+  1. mnist_cnn_train_step_time   — JAXJob-vs-PyTorchJob MNIST (median±IQR,
+                                   steady-state drift check)
+  2. resnet50_train_throughput   — ResNet-50 CIFAR-10 DP step
+  3. bert_base_train_step_time   — BERT-base MLM step with **MFU** from
+                                   analytic FLOPs vs v5e bf16 peak
+  4. katib_time_to_goal          — 16 parallel gang-scheduled trials on a
+                                   simulated 4-slice fleet, bayesian vs
+                                   random time/trials-to-goal
+  5. kserve_bert_p50_latency     — p50/p99 + cold-start through the real
+                                   ModelServer over REST and gRPC
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import statistics
 import sys
 import time
 
-GLOBAL_BATCH = 64
-WARMUP = 5
-TIMED = 30
-TORCH_TIMED = 10
+# v5e peak dense matmul throughput, bf16 (public spec: 197 TFLOP/s/chip).
+V5E_BF16_PEAK_FLOPS = 197e12
 
 
-def bench_jax() -> float:
-    """Our side: DP train step over all local devices. Returns ms/step."""
+def _median_iqr(times_s: list[float]) -> tuple[float, float]:
+    ms = sorted(t * 1e3 for t in times_s)
+    n = len(ms)
+    med = statistics.median(ms)
+    iqr = ms[(3 * n) // 4] - ms[n // 4]
+    return med, iqr
+
+
+def _chained_step_times(
+    step_fn, state, batches, *, reps: int = 5, n_small: int = 5, n_large: int = 20
+):
+    """Per-step seconds, measured honestly on a REMOTE device.
+
+    On this platform ``jax.block_until_ready`` can return before the device
+    finishes (axon tunnels the chip), so single-step timings are fiction.
+    Steps chain through the donated train state, so timing a dependent run
+    of N steps ended by a HOST TRANSFER of a metric scalar (a transfer
+    cannot complete before the compute producing it) is exact up to one
+    constant sync/tunnel round-trip — which the two-point difference
+    t(n_large) - t(n_small) cancels. Returns (state, per-step estimates).
+    """
+    import jax
+    import numpy as np
+
+    def run(n, state, k0):
+        t0 = time.perf_counter()
+        m = None
+        for i in range(n):
+            state, m = step_fn(state, batches[(k0 + i) % len(batches)])
+        np.asarray(jax.tree_util.tree_leaves(m)[0])  # sync: host transfer
+        return time.perf_counter() - t0, state
+
+    estimates, k = [], 0
+    for _ in range(reps):
+        t_small, state = run(n_small, state, k)
+        k += n_small
+        t_large, state = run(n_large, state, k)
+        k += n_large
+        estimates.append((t_large - t_small) / (n_large - n_small))
+    return state, estimates
+
+
+def _steady_state_drift(times_s: list[float]) -> float:
+    """|median(2nd half) - median(1st half)| / median, as a fraction."""
+    h = len(times_s) // 2
+    a = statistics.median(times_s[:h])
+    b = statistics.median(times_s[h:])
+    return abs(b - a) / statistics.median(times_s)
+
+
+# --------------------------------------------------------------------------- #
+# config 1: MNIST CNN train step (JAXJob vs PyTorchJob/gloo-CPU analog)
+# --------------------------------------------------------------------------- #
+
+MNIST_BATCH = 64
+
+
+def bench_mnist() -> dict:
     import jax
     import optax
 
     from kubeflow_tpu.core.mesh import MeshSpec
-    from kubeflow_tpu.data.synthetic import ClassPrototypeDataset, local_shard_iterator
+    from kubeflow_tpu.data.synthetic import (
+        ClassPrototypeDataset,
+        local_shard_iterator,
+    )
     from kubeflow_tpu.models.mnist_cnn import MnistCNN, make_init_fn, make_loss_fn
     from kubeflow_tpu.train.loop import TrainConfig, Trainer
 
+    warmup = 10
     model = MnistCNN()
     trainer = Trainer(
         init_params=make_init_fn(model),
@@ -41,36 +114,52 @@ def bench_jax() -> float:
         optimizer=optax.adam(1e-3),
         config=TrainConfig(
             mesh=MeshSpec.data_parallel(jax.device_count()),
-            global_batch=GLOBAL_BATCH,
-            steps=WARMUP + TIMED,
-            log_every=10_000,  # silent
+            global_batch=MNIST_BATCH,
+            steps=1000,
+            log_every=10_000,
         ),
     )
     state = trainer.init_state()
     step_fn = trainer._build_step(state)
-    data = local_shard_iterator(ClassPrototypeDataset(), GLOBAL_BATCH)
+    data = local_shard_iterator(ClassPrototypeDataset(), MNIST_BATCH)
     batches = [trainer.global_batch_array(next(data)) for _ in range(8)]
 
-    for i in range(WARMUP):
+    for i in range(warmup):
         state, m = step_fn(state, batches[i % len(batches)])
-    jax.block_until_ready(m)
+    import numpy as np
 
-    times = []
-    for i in range(TIMED):
-        t0 = time.perf_counter()
-        state, m = step_fn(state, batches[i % len(batches)])
-        jax.block_until_ready(m)
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times) * 1e3
+    np.asarray(jax.tree_util.tree_leaves(m)[0])
+
+    state, times = _chained_step_times(
+        step_fn, state, batches, reps=7, n_small=10, n_large=40
+    )
+    med, iqr = _median_iqr(times)
+    drift = _steady_state_drift(times)
+
+    torch_ms = _torch_mnist_ms()
+    return {
+        "metric": "mnist_cnn_train_step_time",
+        "value": round(med, 4),
+        "unit": "ms",
+        "vs_baseline": round(torch_ms / med, 3),
+        "detail": {
+            "iqr_ms": round(iqr, 4),
+            "steady_state_drift": round(drift, 4),
+            "steady": drift < 0.25,
+            "timing": "chained two-point (see _chained_step_times)",
+            "global_batch": MNIST_BATCH,
+            "reference_torch_cpu_ms": round(torch_ms, 4),
+        },
+    }
 
 
-def bench_torch_reference() -> float:
-    """Reference side: same CNN/batch, torch CPU (the gloo-backend config's
-    numerics on this host). Returns ms/step."""
+def _torch_mnist_ms() -> float:
     import numpy as np
     import torch
     import torch.nn as nn
     import torch.nn.functional as F
+
+    from kubeflow_tpu.data.synthetic import ClassPrototypeDataset
 
     torch.manual_seed(0)
 
@@ -85,55 +174,593 @@ def bench_torch_reference() -> float:
         def forward(self, x):
             x = F.max_pool2d(F.relu(self.c1(x)), 2)
             x = F.max_pool2d(F.relu(self.c2(x)), 2)
-            x = x.flatten(1)
-            return self.f2(F.relu(self.f1(x)))
-
-    from kubeflow_tpu.data.synthetic import ClassPrototypeDataset
+            return self.f2(F.relu(self.f1(x.flatten(1))))
 
     ds = ClassPrototypeDataset()
     net = Net()
     opt = torch.optim.Adam(net.parameters(), lr=1e-3)
 
     def step(i):
-        x, y = ds.batch(GLOBAL_BATCH, step=i)
+        x, y = ds.batch(MNIST_BATCH, step=i)
         xt = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
         yt = torch.from_numpy(y.astype(np.int64))
         opt.zero_grad()
-        loss = F.cross_entropy(net(xt), yt)
-        loss.backward()
+        F.cross_entropy(net(xt), yt).backward()
         opt.step()
 
     for i in range(3):
         step(i)
     times = []
-    for i in range(TORCH_TIMED):
+    for i in range(12):
         t0 = time.perf_counter()
         step(i)
         times.append(time.perf_counter() - t0)
     return statistics.median(times) * 1e3
 
 
+# --------------------------------------------------------------------------- #
+# config 3: BERT-base MLM train step + MFU (MPIJob-Horovod-allreduce analog)
+# --------------------------------------------------------------------------- #
+
+BERT_BATCH = 32
+BERT_SEQ = 128
+
+
+def bert_train_flops_per_step(
+    batch: int, seq: int, *, layers=12, hidden=768, inter=3072, vocab=30522
+) -> float:
+    """Analytic matmul FLOPs for one BertForMaskedLM train step.
+
+    fwd = 2·S·P_matmul + 4·L·S²·H  (QKᵀ and AV, bidirectional — no causal
+    halving), train = 3×fwd (backward re-does each matmul twice). Embedding
+    gathers and normalizations excluded — they don't ride the MXU.
+    """
+    p_matmul = layers * (4 * hidden * hidden + 2 * hidden * inter)
+    p_head = hidden * hidden + hidden * vocab  # mlm_transform + unembed
+    fwd = 2 * seq * (p_matmul + p_head) + 4 * layers * seq * seq * hidden
+    return 3.0 * batch * fwd
+
+
+def bench_bert() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.core.mesh import MeshSpec
+    from kubeflow_tpu.data.synthetic import TokenLMDataset, local_shard_iterator
+    from kubeflow_tpu.models.bert import (
+        BertForMaskedLM,
+        bert_base,
+        make_mlm_init_fn,
+        make_mlm_loss_fn,
+    )
+    from kubeflow_tpu.train.loop import TrainConfig, Trainer
+
+    warmup = 5
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = bert_base(
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        attn_impl="flash" if on_tpu else "reference",
+    )
+    model = BertForMaskedLM(cfg)
+    trainer = Trainer(
+        init_params=make_mlm_init_fn(model, BERT_SEQ, BERT_BATCH),
+        loss_fn=make_mlm_loss_fn(model),
+        optimizer=optax.adamw(1e-4),
+        config=TrainConfig(
+            mesh=MeshSpec.data_parallel(jax.device_count()),
+            global_batch=BERT_BATCH,
+            steps=1000,
+            log_every=10_000,
+        ),
+    )
+    state = trainer.init_state()
+    step_fn = trainer._build_step(state)
+    ds = TokenLMDataset(vocab_size=cfg.vocab_size, seq_len=BERT_SEQ)
+    data = local_shard_iterator(ds, BERT_BATCH)
+    batches = [trainer.global_batch_array(next(data)) for _ in range(4)]
+
+    import numpy as np
+
+    for i in range(warmup):
+        state, m = step_fn(state, batches[i % len(batches)])
+    np.asarray(jax.tree_util.tree_leaves(m)[0])
+    state, times = _chained_step_times(
+        step_fn, state, batches, reps=5, n_small=5, n_large=20
+    )
+    med, iqr = _median_iqr(times)
+
+    flops = bert_train_flops_per_step(BERT_BATCH, BERT_SEQ)
+    achieved = flops / (med / 1e3)
+    # peak scales with the device count the DP mesh spans
+    peak = V5E_BF16_PEAK_FLOPS * jax.device_count()
+    mfu = achieved / peak if on_tpu else float("nan")
+
+    torch_ms, torch_batch = _torch_bert_ms()
+    # normalize per-sequence: the CPU side can't run the TPU batch size
+    speedup = (torch_ms / torch_batch) / (med / BERT_BATCH)
+    return {
+        "metric": "bert_base_train_step_time",
+        "value": round(med, 3),
+        "unit": "ms",
+        "vs_baseline": round(speedup, 3),
+        "detail": {
+            "iqr_ms": round(iqr, 3),
+            "global_batch": BERT_BATCH,
+            "seq_len": BERT_SEQ,
+            "dtype": "bfloat16" if on_tpu else "float32",
+            "attn_impl": cfg.attn_impl,
+            "analytic_tflops_per_step": round(flops / 1e12, 3),
+            "achieved_tflops_per_s": round(achieved / 1e12, 2),
+            "mfu_pct_vs_v5e_peak": round(mfu * 100, 2) if on_tpu else None,
+            "steady_state_drift": round(_steady_state_drift(times), 4),
+            "reference_torch_cpu_ms": round(torch_ms, 2),
+            "reference_torch_batch": torch_batch,
+            "speedup_is_per_sequence": True,
+        },
+    }
+
+
+def _torch_bert_ms() -> tuple[float, int]:
+    """Reference side: HF torch BertForMaskedLM train step on CPU."""
+    import torch
+    from transformers import BertConfig as HFConfig
+    from transformers import BertForMaskedLM as HFBert
+
+    torch.manual_seed(0)
+    batch = 4
+    net = HFBert(HFConfig())  # bert-base-uncased dimensions, random init
+    opt = torch.optim.AdamW(net.parameters(), lr=1e-4)
+    ids = torch.randint(0, 30522, (batch, BERT_SEQ))
+
+    def step():
+        opt.zero_grad()
+        out = net(input_ids=ids, labels=ids)
+        out.loss.backward()
+        opt.step()
+
+    step()
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        step()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) * 1e3, batch
+
+
+# --------------------------------------------------------------------------- #
+# config 2: ResNet-50 CIFAR-10 DP step (TFJob-MultiWorkerMirrored analog)
+# --------------------------------------------------------------------------- #
+
+RESNET_BATCH = 256
+
+
+def bench_resnet() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.core.mesh import MeshSpec
+    from kubeflow_tpu.data.synthetic import (
+        ClassPrototypeDataset,
+        local_shard_iterator,
+    )
+    from kubeflow_tpu.models.resnet import (
+        ResNet,
+        make_init_fn,
+        make_loss_fn,
+        resnet50_cifar,
+    )
+    from kubeflow_tpu.train.loop import TrainConfig, Trainer
+
+    warmup = 5
+    on_tpu = jax.default_backend() == "tpu"
+    batch = RESNET_BATCH if on_tpu else 32
+    model = ResNet(resnet50_cifar(dtype=jnp.bfloat16 if on_tpu else jnp.float32))
+    trainer = Trainer(
+        init_params=make_init_fn(model),
+        loss_fn=make_loss_fn(model),
+        optimizer=optax.sgd(0.1, momentum=0.9),
+        config=TrainConfig(
+            mesh=MeshSpec.data_parallel(jax.device_count()),
+            global_batch=batch,
+            steps=1000,
+            log_every=10_000,
+        ),
+    )
+    state = trainer.init_state()
+    step_fn = trainer._build_step(state)
+    ds = ClassPrototypeDataset(image_shape=(32, 32, 3))
+    data = local_shard_iterator(ds, batch)
+    batches = [trainer.global_batch_array(next(data)) for _ in range(4)]
+
+    import numpy as np
+
+    for i in range(warmup):
+        state, m = step_fn(state, batches[i % len(batches)])
+    np.asarray(jax.tree_util.tree_leaves(m)[0])
+    state, times = _chained_step_times(
+        step_fn, state, batches, reps=5, n_small=5, n_large=20
+    )
+    med, iqr = _median_iqr(times)
+    img_per_s = batch / (med / 1e3)
+
+    t_ms, t_batch = _torch_resnet_ms()
+    t_img_per_s = t_batch / (t_ms / 1e3)
+    return {
+        "metric": "resnet50_train_throughput",
+        "value": round(img_per_s, 1),
+        "unit": "images/s",
+        "vs_baseline": round(img_per_s / t_img_per_s, 3),
+        "detail": {
+            "step_time_ms": round(med, 3),
+            "iqr_ms": round(iqr, 3),
+            "global_batch": batch,
+            "steady_state_drift": round(_steady_state_drift(times), 4),
+            "reference_torch_cpu_images_per_s": round(t_img_per_s, 1),
+            "reference_torch_batch": t_batch,
+        },
+    }
+
+
+def _torch_resnet_ms() -> tuple[float, int]:
+    """Reference side: torch ResNet-50 (bottleneck [3,4,6,3], CIFAR stem)
+    train step on CPU — same architecture family as models/resnet.py."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    torch.manual_seed(0)
+
+    class Bottleneck(nn.Module):
+        def __init__(self, cin, filters, stride=1):
+            super().__init__()
+            cout = 4 * filters
+            self.c1 = nn.Conv2d(cin, filters, 1, bias=False)
+            self.n1 = nn.GroupNorm(32, filters)
+            self.c2 = nn.Conv2d(filters, filters, 3, stride, 1, bias=False)
+            self.n2 = nn.GroupNorm(32, filters)
+            self.c3 = nn.Conv2d(filters, cout, 1, bias=False)
+            self.n3 = nn.GroupNorm(32, cout)
+            self.proj = (
+                nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.GroupNorm(32, cout),
+                )
+                if (cin != cout or stride != 1)
+                else None
+            )
+
+        def forward(self, x):
+            r = x if self.proj is None else self.proj(x)
+            y = F.relu(self.n1(self.c1(x)))
+            y = F.relu(self.n2(self.c2(y)))
+            return F.relu(self.n3(self.c3(y)) + r)
+
+    class ResNet50(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+            self.norm = nn.GroupNorm(32, 64)
+            layers, cin = [], 64
+            for stage, (n, f) in enumerate(
+                zip((3, 4, 6, 3), (64, 128, 256, 512))
+            ):
+                for b in range(n):
+                    stride = 2 if (stage > 0 and b == 0) else 1
+                    layers.append(Bottleneck(cin, f, stride))
+                    cin = 4 * f
+            self.blocks = nn.Sequential(*layers)
+            self.head = nn.Linear(2048, 10)
+
+        def forward(self, x):
+            x = F.relu(self.norm(self.stem(x)))
+            x = self.blocks(x)
+            return self.head(x.mean(dim=(2, 3)))
+
+    from kubeflow_tpu.data.synthetic import ClassPrototypeDataset
+
+    import numpy as np
+
+    batch = 32
+    ds = ClassPrototypeDataset(image_shape=(32, 32, 3))
+    net = ResNet50()
+    opt = torch.optim.SGD(net.parameters(), lr=0.1, momentum=0.9)
+
+    def step(i):
+        x, y = ds.batch(batch, step=i)
+        xt = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+        yt = torch.from_numpy(y.astype(np.int64))
+        opt.zero_grad()
+        F.cross_entropy(net(xt), yt).backward()
+        opt.step()
+
+    step(0)
+    times = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        step(i + 1)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) * 1e3, batch
+
+
+# --------------------------------------------------------------------------- #
+# config 4: Katib 16 parallel gang-scheduled trials — time-to-goal
+# --------------------------------------------------------------------------- #
+
+
+def bench_katib() -> dict:
+    """16 parallel JAXJob trials contending for a simulated 4-slice fleet;
+    bayesian (GP-EI) vs random search, same fleet, same goal."""
+    from kubeflow_tpu.orchestrator.cluster import LocalCluster
+    from kubeflow_tpu.orchestrator.envwire import WiringConfig
+    from kubeflow_tpu.orchestrator.resources import Fleet
+    from kubeflow_tpu.tune.controller import ExperimentController, JobTrialRunner
+    from kubeflow_tpu.tune.spec import (
+        AlgorithmSpec,
+        ExperimentSpec,
+        Objective,
+        ObjectiveType,
+        ParameterSpec,
+        ParameterType,
+    )
+
+    # trial payload: no jax import (fast spawn); quadratic bowl in log10(lr),
+    # optimum lr=1e-2 → loss 0; goal 1e-4 needs log-distance < 0.01 —
+    # ~0.5% of the uniform-log space per draw, so random search needs ~200
+    # draws in expectation (> max_trial_count) while GP-EI concentrates fast
+    template = {
+        "replicas": {
+            "worker": {
+                "replicas": 1,
+                "command": [
+                    sys.executable,
+                    "-c",
+                    "import math; lr=float('${trialParameters.lr}'); "
+                    "print(f'step=1 loss={(math.log10(lr)+2.0)**2:.6f}')",
+                ],
+                "tpu": {"chips": 4},
+            }
+        },
+        "run_policy": {"backoff_limit": 0},
+    }
+
+    goal = 1e-4
+
+    def run(algorithm: str, seed: int, max_trials: int) -> dict:
+        spec = ExperimentSpec(
+            name=f"lr-sweep-{algorithm}-{seed}",
+            parameters=(
+                ParameterSpec(
+                    "lr", ParameterType.DOUBLE, min=1e-4, max=1.0, log_scale=True
+                ),
+            ),
+            objective=Objective("loss", ObjectiveType.MINIMIZE, goal=goal),
+            algorithm=AlgorithmSpec(algorithm),
+            parallel_trial_count=16,
+            max_trial_count=max_trials,
+            trial_template=template,
+        )
+        with LocalCluster(
+            fleet=Fleet.homogeneous(4, "2x2"),
+            wiring=WiringConfig(platform="cpu_sim", devices_per_worker=4),
+            resync_period=0.05,
+        ) as cluster:
+            runner = JobTrialRunner(cluster, poll_s=0.05, timeout_s=120)
+            t0 = time.perf_counter()
+            status = ExperimentController(spec, runner, seed=seed).run()
+            dt = time.perf_counter() - t0
+        vals = [
+            t.metrics["__objective__"]
+            for t in status.trials
+            if t.metrics.get("__objective__") is not None
+        ]
+        best = min(vals) if vals else None
+        return {
+            "seconds": dt,
+            "launched": len(status.trials),
+            "goal_met": best is not None and best <= goal,
+            "best": best,
+        }
+
+    # random gets a larger budget so its time-to-goal is a real measurement,
+    # not an early give-up at the bayesian budget
+    bayes = run("bayesian", seed=1, max_trials=64)
+    rand = run("random", seed=1, max_trials=512)
+    both_met = bayes["goal_met"] and rand["goal_met"]
+    return {
+        "metric": "katib_time_to_goal",
+        "value": round(bayes["seconds"], 2),
+        "unit": "s",
+        # trials are the real cost on TPU fleets (each is minutes of chip
+        # time; the 0.1s subprocess spawn here is not the economics), so the
+        # efficiency ratio is trials-to-goal, not spawn-bound wall time
+        "vs_baseline": (
+            round(rand["launched"] / bayes["launched"], 3) if both_met else None
+        ),
+        "detail": {
+            "algorithm": "bayesian (GP-EI)",
+            "parallel_trials": 16,
+            "fleet": "4 x 2x2 v5e slices (simulated)",
+            "goal": goal,
+            "bayes": {k: (round(v, 4) if isinstance(v, float) else v)
+                      for k, v in bayes.items()},
+            "random": {k: (round(v, 4) if isinstance(v, float) else v)
+                       for k, v in rand.items()},
+            "baseline_is": (
+                "random search, same fleet/goal; vs_baseline = "
+                "random trials-to-goal / bayesian trials-to-goal"
+            ),
+        },
+    }
+
+
+# --------------------------------------------------------------------------- #
+# config 5: KServe BERT predictor p50/p99 + cold start (REST + gRPC)
+# --------------------------------------------------------------------------- #
+
+
+def bench_serving() -> dict:
+    import numpy as np
+
+    from kubeflow_tpu.serve.grpc_server import (
+        GrpcInferenceClient,
+        GrpcInferenceServer,
+    )
+    from kubeflow_tpu.serve.model import BucketSpec
+    from kubeflow_tpu.serve.runtimes import BertRuntimeModel
+    from kubeflow_tpu.serve.server import ModelServer
+
+    # cold start = weights→HBM + compile of every serving bucket
+    t0 = time.perf_counter()
+    model = BertRuntimeModel(
+        "bert", None, buckets=BucketSpec(batch_sizes=(1, 8), seq_lens=(128,))
+    )
+    model.load()
+    model.warmup()
+    cold_s = time.perf_counter() - t0
+
+    server = ModelServer([model])
+    text = "the quick brown fox [MASK] over the lazy dog in the bright morning"
+    n_req = 40
+
+    async def rest_latencies() -> list[float]:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        lat = []
+        async with TestClient(TestServer(server.build_app())) as client:
+            for _ in range(3):  # connection + route warmup
+                await client.post(
+                    "/v1/models/bert:predict", json={"instances": [text]}
+                )
+            for _ in range(n_req):
+                t = time.perf_counter()
+                r = await client.post(
+                    "/v1/models/bert:predict", json={"instances": [text]}
+                )
+                assert r.status == 200
+                await r.json()
+                lat.append(time.perf_counter() - t)
+        return lat
+
+    rest_lat = sorted(asyncio.run(rest_latencies()))
+
+    g = GrpcInferenceServer(server.dataplane, port=0)
+    port = g.start()
+    try:
+        c = GrpcInferenceClient(f"localhost:{port}")
+        ids = np.asarray([model.tokenizer.encode(text)], np.int32)
+        grpc_lat = []
+        for _ in range(3):
+            c.infer("bert", {"input_ids": ids})
+        for _ in range(n_req):
+            t = time.perf_counter()
+            c.infer("bert", {"input_ids": ids})
+            grpc_lat.append(time.perf_counter() - t)
+        c.close()
+    finally:
+        g.stop()
+    grpc_lat.sort()
+
+    def pct(lat, q):
+        return lat[min(len(lat) - 1, int(len(lat) * q))] * 1e3
+
+    torch_p50 = _torch_bert_infer_p50()
+    p50 = statistics.median(rest_lat) * 1e3
+    return {
+        "metric": "kserve_bert_p50_latency",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(torch_p50 / p50, 3),
+        "detail": {
+            "rest_p99_ms": round(pct(rest_lat, 0.99), 3),
+            "grpc_p50_ms": round(statistics.median(grpc_lat) * 1e3, 3),
+            "grpc_p99_ms": round(pct(grpc_lat, 0.99), 3),
+            "cold_start_s": round(cold_s, 2),
+            "requests": n_req,
+            "reference_torch_cpu_p50_ms": round(torch_p50, 2),
+            "transport": "real aiohttp server + real gRPC server, batch-1",
+        },
+    }
+
+
+def _torch_bert_infer_p50() -> float:
+    """Reference side: HF torch BERT-base forward, CPU, batch-1 seq-128."""
+    import torch
+    from transformers import BertConfig as HFConfig
+    from transformers import BertForMaskedLM as HFBert
+
+    net = HFBert(HFConfig()).eval()
+    ids = torch.randint(0, 30522, (1, 128))
+    with torch.no_grad():
+        net(input_ids=ids)
+        lat = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            net(input_ids=ids)
+            lat.append(time.perf_counter() - t0)
+    return statistics.median(lat) * 1e3
+
+
+# --------------------------------------------------------------------------- #
+
+
 def main() -> int:
-    jax_ms = bench_jax()
-    torch_ms = bench_torch_reference()
     import jax
 
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_cnn_train_step_time",
-                "value": round(jax_ms, 4),
-                "unit": "ms",
-                "vs_baseline": round(torch_ms / jax_ms, 3),
-                "detail": {
-                    "backend": jax.default_backend(),
-                    "devices": jax.device_count(),
-                    "global_batch": GLOBAL_BATCH,
-                    "reference_torch_cpu_ms": round(torch_ms, 4),
-                },
+    results: list[dict] = []
+    for fn in (bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving):
+        try:
+            r = fn()
+        except Exception as e:  # one broken config must not hide the rest
+            r = {
+                "metric": fn.__name__,
+                "value": None,
+                "unit": "error",
+                "vs_baseline": None,
+                "detail": {"error": f"{type(e).__name__}: {e}"},
             }
-        )
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    bert = next(
+        (r for r in results if r["metric"] == "bert_base_train_step_time"), None
     )
+    mfu = (bert or {}).get("detail", {}).get("mfu_pct_vs_v5e_peak")
+    headline = {
+        "metric": "bert_base_train_mfu",
+        "value": mfu,
+        "unit": "%",
+        "vs_baseline": (bert or {}).get("vs_baseline"),
+        "detail": {
+            "backend": jax.default_backend(),
+            "devices": jax.device_count(),
+            "note": "MFU = analytic matmul FLOPs / v5e bf16 peak (197 TFLOP/s)",
+            "all_metrics": {
+                r["metric"]: {
+                    "value": r["value"],
+                    "unit": r["unit"],
+                    "vs_baseline": r["vs_baseline"],
+                    **{
+                        k: v
+                        for k, v in r.get("detail", {}).items()
+                        if k
+                        in (
+                            "mfu_pct_vs_v5e_peak",
+                            "iqr_ms",
+                            "steady_state_drift",
+                            "cold_start_s",
+                            "rest_p99_ms",
+                            "grpc_p50_ms",
+                            "error",
+                        )
+                    },
+                }
+                for r in results
+            },
+        },
+    }
+    print(json.dumps(headline), flush=True)
     return 0
 
 

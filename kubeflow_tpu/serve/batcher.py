@@ -84,10 +84,22 @@ class Batcher:
             for i in range(0, len(flat), step):
                 outputs.extend(await self._handler(flat[i : i + step]))
                 self.stats["batches"] += 1
-        except Exception as e:  # propagate the failure to every caller
-            for _, fut in queue:
+        except Exception as e:
+            if len(queue) == 1:
+                _, fut = queue[0]
                 if not fut.done():
                     fut.set_exception(e)
+                return
+            # Isolate the offender: re-run each caller's instances alone so
+            # one malformed request doesn't fail every co-batched one.
+            for instances, fut in queue:
+                if fut.done():
+                    continue
+                try:
+                    fut.set_result(list(await self._handler(list(instances))))
+                    self.stats["batches"] += 1
+                except Exception as per:
+                    fut.set_exception(per)
             return
         self.stats["instances"] += len(flat)
         off = 0

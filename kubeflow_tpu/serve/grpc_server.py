@@ -172,17 +172,15 @@ class GrpcInferenceServer:
                 raise ValueError("infer request has no input tensors")
         except ValueError as e:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        # same payload mapping as the REST v2 endpoint (server.py _v2_infer)
-        ids = tensors.get("input_ids")
-        payload = {
-            "instances": (
-                ids if ids is not None else next(iter(tensors.values()))
-            ).tolist()
-        }
+        # same payload mapping as the REST v2 endpoint (server.py _v2_infer):
+        # the DataPlane itself splits named tensors into per-instance rows,
+        # so attention_mask/token_type_ids reach the model on both transports
         from aiohttp import web
 
         try:
-            result = self._run(self.dataplane.infer(req.model_name, payload))
+            result = self._run(
+                self.dataplane.infer(req.model_name, {"inputs": tensors})
+            )
         except web.HTTPNotFound:
             ctx.abort(
                 grpc.StatusCode.NOT_FOUND, f"model {req.model_name!r} not found"
@@ -236,9 +234,24 @@ class GrpcInferenceServer:
         return self.port
 
     def stop(self, grace: float = 0.5) -> None:
+        """Blocking stop — only safe OFF the event loop the DataPlane runs
+        on (standalone/owned-loop use). Shared-loop callers (ModelServer)
+        must use ``stop_async``: blocking the shared loop here would strand
+        every in-flight RPC waiting on a coroutine scheduled to that loop."""
         if self._server is not None:
             self._server.stop(grace).wait()
             self._server = None
+        self._close_owned_loop()
+
+    async def stop_async(self, grace: float = 0.5) -> None:
+        """Drain without blocking the calling event loop."""
+        if self._server is not None:
+            done = self._server.stop(grace)
+            await asyncio.get_running_loop().run_in_executor(None, done.wait)
+            self._server = None
+        self._close_owned_loop()
+
+    def _close_owned_loop(self) -> None:
         if self._owns_loop:
             if self._loop_thread is not None and self._loop_thread.is_alive():
                 self._loop.call_soon_threadsafe(self._loop.stop)
@@ -273,14 +286,26 @@ class GrpcInferenceClient:
         self, model_name: str, inputs: dict[str, np.ndarray]
     ) -> dict[str, np.ndarray]:
         req = pb.ModelInferRequest(model_name=model_name)
-        for name, arr in inputs.items():
-            arr = np.asarray(arr)
+        arrays = {n: np.asarray(a) for n, a in inputs.items()}
+        # FP16/BF16 have no InferTensorContents field in the published spec,
+        # so they must ride raw_input_contents — and the spec requires raw
+        # to be all-or-nothing across a request's inputs.
+        use_raw = any(
+            _NP_TO_V2.get(a.dtype.name, "FP32") in ("FP16", "BF16")
+            for a in arrays.values()
+        )
+        for name, arr in arrays.items():
             t = req.inputs.add()
             t.name = name
             t.datatype = _NP_TO_V2.get(arr.dtype.name, "FP32")
             t.shape.extend(arr.shape)
-            field = _CONTENTS_FIELD[t.datatype]
-            getattr(t.contents, field).extend(arr.reshape(-1).tolist())
+            if use_raw:
+                req.raw_input_contents.append(
+                    np.ascontiguousarray(arr).tobytes()
+                )
+            else:
+                field = _CONTENTS_FIELD[t.datatype]
+                getattr(t.contents, field).extend(arr.reshape(-1).tolist())
         resp = self._call("ModelInfer", req, pb.ModelInferResponse)
         out = {}
         for i, t in enumerate(resp.outputs):

@@ -14,6 +14,12 @@ import uuid
 from typing import Any, Callable
 
 
+def _json_fallback(obj: Any):
+    if hasattr(obj, "tolist"):  # numpy arrays and scalars
+        return obj.tolist()
+    return str(obj)
+
+
 class RequestLogger:
     def __init__(self, sink: Callable[[dict], None] | str | None = None):
         self.entries: list[dict] = []
@@ -27,7 +33,9 @@ class RequestLogger:
             self._sink = self.entries.append
 
     def _write_file(self, event: dict) -> None:
-        self._file.write(json.dumps(event) + "\n")
+        # v2 named-tensor payloads carry numpy arrays; a JSONL sink must not
+        # 500 every request over them
+        self._file.write(json.dumps(event, default=_json_fallback) + "\n")
 
     def _emit(self, event_type: str, model: str, req_id: str, payload: Any) -> None:
         self._sink(

@@ -112,6 +112,25 @@ class JAXModel(Model):
     ):
         super().__init__(name)
         self._apply_fn = apply_fn
+        # apply_fn may take (params, ids, mask) or (params, ids, mask,
+        # token_type_ids); probe once so the jitted wrapper has one arity.
+        # Only REQUIRED POSITIONAL parameters count — a keyword-only or
+        # defaulted 4th parameter (dropout rng, deterministic flag) must not
+        # be mistaken for a token_type_ids slot.
+        import inspect
+
+        try:
+            required_positional = [
+                p
+                for p in inspect.signature(apply_fn).parameters.values()
+                if p.kind
+                in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                and p.default is p.empty
+            ]
+            n_args = len(required_positional)
+        except (TypeError, ValueError):
+            n_args = 3
+        self._apply_takes_tt = n_args >= 4
         self._init_params = init_params
         self.buckets = buckets or BucketSpec()
         self._sharding = sharding
@@ -137,8 +156,11 @@ class JAXModel(Model):
         self._params = params
 
         inner = self._apply_fn
+        takes_tt = self._apply_takes_tt
 
-        def fwd(params, input_ids, attention_mask):
+        def fwd(params, input_ids, attention_mask, token_type_ids):
+            if takes_tt:
+                return inner(params, input_ids, attention_mask, token_type_ids)
             return inner(params, input_ids, attention_mask)
 
         self._jitted = jax.jit(fwd)
@@ -156,33 +178,81 @@ class JAXModel(Model):
             for s in self.buckets.seq_lens:
                 ids = np.zeros((b, s), np.int32)
                 mask = np.zeros((b, s), np.int32)
-                jax.block_until_ready(self._jitted(self._params, ids, mask))
+                jax.block_until_ready(
+                    self._jitted(self._params, ids, mask, np.zeros_like(ids))
+                )
 
     # -- data path ----------------------------------------------------------
 
-    def preprocess(self, payload: Any, headers: Mapping[str, str] | None = None) -> Any:
-        """Accepts {"instances": [[ids...], ...]} (v1) or an int array."""
+    def _normalize_row(self, r: Any) -> Any:
+        """One instance → 1-D id array, or a dict of named 1-D arrays
+        (input_ids required; attention_mask/token_type_ids optional)."""
+        if isinstance(r, Mapping):
+            if "input_ids" not in r:
+                raise ValueError(
+                    f"named instance must carry 'input_ids'; got {sorted(r)}"
+                )
+            out = {"input_ids": np.asarray(r["input_ids"], np.int32).reshape(-1)}
+            n = out["input_ids"].shape[0]
+            for k in ("attention_mask", "token_type_ids"):
+                if r.get(k) is not None:
+                    arr = np.asarray(r[k], np.int32).reshape(-1)
+                    if arr.shape[0] != n:
+                        # reject HERE with a clear message — a ragged row
+                        # reaching predict() would crash the shared batch
+                        raise ValueError(
+                            f"{k} length {arr.shape[0]} != input_ids length {n}"
+                        )
+                    out[k] = arr
+            return out
+        return np.asarray(r, np.int32)
+
+    @staticmethod
+    def payload_rows(payload: Any) -> list[Any]:
+        """{"instances": [...]} | {"inputs": {name: batch array}} | sequence
+        → raw per-instance rows. THE single normalization point for the two
+        payload shapes (DataPlane and every runtime route through here)."""
+        if isinstance(payload, Mapping) and isinstance(payload.get("inputs"), Mapping):
+            from kubeflow_tpu.serve.protocol import rows_from_named
+
+            return rows_from_named(payload["inputs"])
         if isinstance(payload, Mapping) and "instances" in payload:
-            payload = payload["instances"]
-        rows = [np.asarray(r, np.int32) for r in payload]
+            return list(payload["instances"])
+        return list(payload)
+
+    def preprocess(self, payload: Any, headers: Mapping[str, str] | None = None) -> Any:
+        """Accepts {"instances": [...]} (rows = id lists or named dicts) or
+        {"inputs": {name: batch-major array}} (v2 named tensors)."""
+        rows = [self._normalize_row(r) for r in self.payload_rows(payload)]
         if not rows:
             raise ValueError("empty request")
         return rows
 
-    def predict(self, inputs: Sequence[np.ndarray], headers=None) -> np.ndarray:
+    def predict(self, inputs: Sequence[Any], headers=None) -> np.ndarray:
+        def ids_of(r):
+            return r["input_ids"] if isinstance(r, Mapping) else r
+
         n = len(inputs)
-        s = max(int(r.shape[-1]) for r in inputs)
+        s = max(int(ids_of(r).shape[-1]) for r in inputs)
         bb = self.buckets.bucket_batch(n)
         bs = self.buckets.bucket_seq(s)
         ids = np.full((bb, bs), self._pad_id, np.int32)
         mask = np.zeros((bb, bs), np.int32)
+        tt = np.zeros((bb, bs), np.int32)
         for i, r in enumerate(inputs):
-            ids[i, : r.shape[-1]] = r
-            mask[i, : r.shape[-1]] = 1
+            row = ids_of(r)
+            ln = row.shape[-1]
+            ids[i, :ln] = row
+            if isinstance(r, Mapping) and "attention_mask" in r:
+                mask[i, :ln] = r["attention_mask"][:ln]
+            else:
+                mask[i, :ln] = 1
+            if isinstance(r, Mapping) and "token_type_ids" in r:
+                tt[i, :ln] = r["token_type_ids"][:ln]
 
         before = self._compile_count()
         t0 = time.perf_counter()
-        out = self._jitted(self._params, ids, mask)
+        out = self._jitted(self._params, ids, mask, tt)
         out = np.asarray(jax.block_until_ready(out))
         self.stats["predict_ms"].append((time.perf_counter() - t0) * 1e3)
         self.stats["requests"] += 1

@@ -166,10 +166,21 @@ class BertRuntimeModel(JAXModel):
                     f"checkpoint: {e}"
                 ) from e
 
-        def apply_fn(params, input_ids, attention_mask):
-            return model.apply(
-                {"params": params}, input_ids, attention_mask=attention_mask
+        def apply_fn(params, input_ids, attention_mask, token_type_ids):
+            logits = model.apply(
+                {"params": params},
+                input_ids,
+                attention_mask=attention_mask,
+                token_type_ids=token_type_ids,
             )
+            # Decode ON DEVICE: the response is the top token per slot, so
+            # ship (B,S) int32 ids — not (B,S,V) float logits. For
+            # bert-base that is 512 bytes instead of 15.6 MB per request,
+            # and host↔device transfer is the serving hot path's bottleneck
+            # (SURVEY.md §3.3 "TPU mapping": HBM-resident, minimal egress).
+            import jax.numpy as jnp
+
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         super().__init__(
             name,
@@ -180,19 +191,24 @@ class BertRuntimeModel(JAXModel):
         )
 
     def preprocess(self, payload: Any, headers: Mapping[str, str] | None = None):
-        if isinstance(payload, Mapping) and "instances" in payload:
-            payload = payload["instances"]
         rows = []
-        for inst in payload:
+        for inst in self.payload_rows(payload):
             if isinstance(inst, str):
                 rows.append(np.asarray(self.tokenizer.encode(inst), np.int32))
+            elif isinstance(inst, Mapping) and isinstance(inst.get("text"), str):
+                rows.append(
+                    np.asarray(self.tokenizer.encode(inst["text"]), np.int32)
+                )
             else:
-                rows.append(np.asarray(inst, np.int32))
+                # named dict rows (attention_mask/token_type_ids) or raw ids
+                rows.append(self._normalize_row(inst))
         return rows
 
     def postprocess(self, outputs: np.ndarray, headers=None) -> Any:
-        top = np.argmax(outputs, axis=-1)  # (batch, seq) top token per slot
-        return {"predictions": top.tolist()}
+        # (batch, seq) token ids — argmax already ran on device in apply_fn
+        if outputs.ndim == 3:  # a custom apply_fn returning raw logits
+            outputs = np.argmax(outputs, axis=-1)
+        return {"predictions": outputs.tolist()}
 
 
 def default_registry() -> RuntimeRegistry:

@@ -199,16 +199,30 @@ class ExperimentController:
             self.db.record_trial(self.spec.name, trial)
             obj = self.spec.objective
             if trial.observations:
-                # replace (don't double-append) this trial's observation log
+                # Append only when the stored log is an exact PREFIX of the
+                # in-memory log (normalizing tuple-vs-list rows); anything
+                # else — divergent values, a longer stored log from a prior
+                # controller — is rewritten atomically. A blind tail-append
+                # on divergence recorded wrong observations (ADVICE r2).
+                want = [(int(s), float(v)) for s, v in trial.observations]
                 have = self.db.observations(
                     self.spec.name, trial.assignment.trial_id, obj.metric
                 )
-                if have != trial.observations:
+                if have == want:
+                    pass
+                elif len(have) < len(want) and have == want[: len(have)]:
                     self.db.report_observations(
                         self.spec.name,
                         trial.assignment.trial_id,
                         obj.metric,
-                        trial.observations[len(have):],
+                        want[len(have):],
+                    )
+                else:
+                    self.db.replace_observations(
+                        self.spec.name,
+                        trial.assignment.trial_id,
+                        obj.metric,
+                        want,
                     )
 
     # -- main loop ----------------------------------------------------------

@@ -6,7 +6,7 @@ survive controller restarts (SURVEY.md §2.3 "DB manager + storage" row;
 UNVERIFIED, mount empty — §0). Here: sqlite (available in this image) with
 the same two tables — trials and observation logs — and the same
 restart-resume contract, exercised by
-tests/test_tune_persistence.py::test_experiment_resumes_after_controller_restart.
+tests/test_persistence.py::test_experiment_resumes_after_controller_restart.
 """
 
 from __future__ import annotations
@@ -115,6 +115,31 @@ class TrialDB:
     ) -> None:
         with self._lock:
             now = time.time()
+            self._db.executemany(
+                "INSERT INTO observations"
+                " (experiment, trial_id, metric, step, value, ts)"
+                " VALUES (?,?,?,?,?,?)",
+                [
+                    (experiment, trial_id, metric, int(s), float(v), now)
+                    for s, v in series
+                ],
+            )
+            self._db.commit()
+
+    def replace_observations(
+        self, experiment: str, trial_id: str, metric: str,
+        series: list[tuple[int, float]],
+    ) -> None:
+        """Atomically rewrite one trial's observation log for a metric —
+        the recovery path when a stored log diverges from the in-memory
+        one (restart races); plain appends would record a wrong tail."""
+        with self._lock:
+            now = time.time()
+            self._db.execute(
+                "DELETE FROM observations"
+                " WHERE experiment=? AND trial_id=? AND metric=?",
+                (experiment, trial_id, metric),
+            )
             self._db.executemany(
                 "INSERT INTO observations"
                 " (experiment, trial_id, metric, step, value, ts)"
