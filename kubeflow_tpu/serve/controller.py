@@ -70,12 +70,17 @@ class InferenceServiceController:
         model_dir: str = "/tmp/kubeflow_tpu_models",
         idle_scale_to_zero_s: float = 30.0,
         rng: random.Random | None = None,
+        model_mesh=None,
     ):
         self.registry = registry
         self.model_dir = model_dir
         self.idle_scale_to_zero_s = idle_scale_to_zero_s
         self._services: dict[str, ServiceState] = {}
         self._rng = rng or random.Random(0)
+        #: optional ModelMesh (serve/modelmesh.py): when set, predictors are
+        #: REGISTERED rather than loaded — N services share one HBM budget
+        #: with on-demand load + LRU eviction (SURVEY.md §2.2 ModelMesh row)
+        self.model_mesh = model_mesh
 
     # -- CRD-ish API --------------------------------------------------------
 
@@ -154,6 +159,23 @@ class InferenceServiceController:
         if p.storage_uri is not None:
             local_path = storage_mod.download(
                 p.storage_uri, f"{self.model_dir}/{spec.name}"
+            )
+        if self.model_mesh is not None:
+            import hashlib
+
+            from kubeflow_tpu.serve.modelmesh import MeshBackedModel
+
+            # key by (service, spec-hash): a rollout materialises a NEW mesh
+            # entry, so the outgoing model's unload() cannot take the new
+            # one's registration down with it
+            spec_hash = hashlib.sha256(
+                repr(_mat_key(p)).encode()
+            ).hexdigest()[:12]
+            return MeshBackedModel(
+                self.model_mesh,
+                spec.name,
+                lambda: rt.factory(spec.name, local_path, **dict(p.extra)),
+                key=f"{spec.namespace}/{spec.name}@{spec_hash}",
             )
         model = rt.factory(spec.name, local_path, **dict(p.extra))
         if not model.ready:

@@ -1,0 +1,285 @@
+"""High-density multi-model serving — the ModelMesh analog.
+
+Reference analog: the ModelMesh project KServe integrates for high-density
+serving ([kserve] ModelMesh row, SURVEY.md §2.2 — UNVERIFIED, mount empty,
+§0): many registered models share a serving fleet's memory; models load on
+demand, evict least-recently-used, and report per-model readiness.
+
+TPU-native re-design: the scarce resource is ONE chip's HBM (weights are
+HBM-resident by design — serve/model.py), so the unit of placement is
+"params in HBM" rather than "model container on a pod". A ``ModelMesh``
+holds N *registered* models (factories — cheap), materialises one into HBM
+on first request, measures its actual device footprint, and LRU-evicts
+until the budget holds. Loading is fail-closed per model: a broken model
+reports FAILED and never poisons its neighbours.
+
+States: REGISTERED (known, not resident) → LOADING → LOADED (HBM-resident)
+→ back to REGISTERED on eviction; FAILED on load error (sticky until the
+next explicit load attempt).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+import jax
+
+from kubeflow_tpu.serve.model import Model
+
+
+class ModelState:
+    REGISTERED = "Registered"   # known; weights not resident
+    LOADING = "Loading"
+    LOADED = "Loaded"           # weights in HBM, serving
+    FAILED = "FailedToLoad"
+
+
+def _device_bytes(model: Model) -> int:
+    """Measured HBM footprint: sum of device-array param bytes."""
+    params = getattr(model, "_params", None)
+    if params is None:
+        return 0
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+class _Entry:
+    def __init__(self, name: str, factory: Callable[[], Model]):
+        self.name = name
+        self.factory = factory
+        self.model: Model | None = None
+        self.state = ModelState.REGISTERED
+        self.bytes = 0
+        self.last_used = 0.0
+        self.loads = 0
+        self.error: str | None = None
+        self.failed_at = 0.0
+
+
+class ModelMesh:
+    """LRU-managed registry of models sharing one HBM budget."""
+
+    def __init__(
+        self,
+        hbm_budget_bytes: int,
+        *,
+        clock=time.monotonic,
+        retry_cooldown_s: float = 5.0,
+    ):
+        if hbm_budget_bytes <= 0:
+            raise ValueError("hbm_budget_bytes must be positive")
+        self.budget = int(hbm_budget_bytes)
+        self._clock = clock
+        self._lock = threading.RLock()
+        #: serializes loads: two concurrent loads could each pass the budget
+        #: check against only-LOADED residency and jointly overshoot HBM —
+        #: the one invariant this class exists to enforce. Loads are rare
+        #: and slow (weights → HBM); coarse serialization is the right cost.
+        self._load_lock = threading.Lock()
+        #: a FAILED load becomes retryable after this long (transient
+        #: storage flakes must not be a permanent 503 — see MeshBackedModel)
+        self.retry_cooldown_s = retry_cooldown_s
+        self._entries: dict[str, _Entry] = {}
+        self.stats: dict[str, int] = {
+            "loads": 0, "evictions": 0, "hits": 0, "misses": 0,
+        }
+
+    # -- registry ---------------------------------------------------------- #
+
+    def register(self, name: str, factory: Callable[[], Model]) -> None:
+        """Make a model servable WITHOUT loading it (density is the point:
+        registration is O(1) metadata, HBM is spent only on demand)."""
+        with self._lock:
+            if name not in self._entries:
+                self._entries[name] = _Entry(name, factory)
+
+    def deregister(self, name: str) -> None:
+        with self._lock:
+            e = self._entries.pop(name, None)
+        if e is not None and e.model is not None:
+            e.model.unload()
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def resident(self) -> list[str]:
+        with self._lock:
+            return sorted(
+                n for n, e in self._entries.items()
+                if e.state == ModelState.LOADED
+            )
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(
+                e.bytes for e in self._entries.values()
+                if e.state == ModelState.LOADED
+            )
+
+    def readiness(self, name: str) -> Mapping[str, Any]:
+        with self._lock:
+            e = self._entries[name]
+            return {
+                "name": name,
+                "state": e.state,
+                "bytes": e.bytes,
+                "loads": e.loads,
+                "error": e.error,
+                "failed_at": e.failed_at,
+            }
+
+    # -- placement ---------------------------------------------------------- #
+
+    def model(self, name: str) -> Model:
+        """The serving entry point: resident → touch; else load (evicting
+        LRU residents as needed). Raises KeyError for unknown models and
+        RuntimeError for models that cannot load or fit. FAILED entries stay
+        rejected for ``retry_cooldown_s``, then the next request retries."""
+        with self._lock:
+            if name not in self._entries:
+                raise KeyError(name)
+            e = self._entries[name]
+            if e.state == ModelState.LOADED:
+                e.last_used = self._clock()
+                self.stats["hits"] += 1
+                return e.model
+            if (
+                e.state == ModelState.FAILED
+                and self._clock() - e.failed_at < self.retry_cooldown_s
+            ):
+                raise RuntimeError(
+                    f"model {name!r} failed to load: {e.error} (retry in "
+                    f"{self.retry_cooldown_s:.0f}s)"
+                )
+        # one load at a time: budget math must never race (see _load_lock)
+        with self._load_lock:
+            with self._lock:
+                if name not in self._entries:
+                    raise KeyError(name)
+                e = self._entries[name]
+                if e.state == ModelState.LOADED:  # a waiter: loaded meanwhile
+                    e.last_used = self._clock()
+                    self.stats["hits"] += 1
+                    return e.model
+                self.stats["misses"] += 1
+                e.state = ModelState.LOADING
+            try:
+                model = e.factory()
+                if not model.ready:
+                    model.load()
+                size = _device_bytes(model)
+            except Exception as ex:
+                with self._lock:
+                    e.state = ModelState.FAILED
+                    e.error = f"{type(ex).__name__}: {ex}"
+                    e.failed_at = self._clock()
+                raise RuntimeError(
+                    f"model {name!r} failed to load: {ex}"
+                ) from ex
+            with self._lock:
+                if size > self.budget:
+                    e.state = ModelState.FAILED
+                    e.error = (
+                        f"model needs {size} bytes > budget {self.budget}"
+                    )
+                    e.failed_at = self._clock()
+                    model.unload()
+                    raise RuntimeError(e.error)
+                self._evict_locked(need=size, keep=name)
+                e.model = model
+                e.bytes = size
+                e.state = ModelState.LOADED
+                e.error = None
+                e.loads += 1
+                e.last_used = self._clock()
+                self.stats["loads"] += 1
+                return model
+
+    def _evict_locked(self, need: int, keep: str) -> None:
+        """Evict least-recently-used residents until ``need`` fits."""
+        while self.resident_bytes() + need > self.budget:
+            victims = [
+                e for n, e in self._entries.items()
+                if e.state == ModelState.LOADED and n != keep
+            ]
+            if not victims:
+                raise RuntimeError(
+                    f"cannot fit {need} bytes within budget {self.budget}"
+                )
+            victim = min(victims, key=lambda e: e.last_used)
+            victim.model.unload()
+            victim.model = None
+            victim.bytes = 0
+            victim.state = ModelState.REGISTERED
+            self.stats["evictions"] += 1
+
+
+class MeshBackedModel(Model):
+    """``Model``-shaped proxy over a ModelMesh entry, so the existing
+    DataPlane / InferenceServiceController placement paths (serve/server.py,
+    serve/controller.py) serve mesh-managed models unchanged: readiness maps
+    to the mesh state, the data path pulls the model in (evicting LRU) on
+    demand."""
+
+    def __init__(
+        self,
+        mesh: ModelMesh,
+        name: str,
+        factory: Callable[[], Model],
+        *,
+        key: str | None = None,
+    ):
+        # ``key`` is the mesh registry identity; it must be UNIQUE per
+        # materialisation (the controller keys it by spec hash) so that a
+        # rollout's new proxy never aliases the old one's factory, and the
+        # old proxy's unload() removes only its own entry.
+        self.name = name
+        self.key = key or name
+        self._mesh = mesh
+        mesh.register(self.key, factory)
+
+    @property
+    def ready(self) -> bool:
+        try:
+            info = self._mesh.readiness(self.key)
+        except KeyError:
+            return False
+        if info["state"] != ModelState.FAILED:
+            # registered-but-not-resident still answers requests (load on
+            # first use) — ModelMesh's "available" vs "loaded" distinction
+            return True
+        # FAILED: not-ready (503) during the cooldown so a broken model
+        # doesn't reload-storm; ready again afterwards so the next request
+        # reaches mesh.model(), the ONLY retry path from the data plane
+        age = self._mesh._clock() - info.get("failed_at", 0.0)
+        return age >= self._mesh.retry_cooldown_s
+
+    @ready.setter
+    def ready(self, value: bool) -> None:
+        pass  # state lives in the mesh; Model.__init__-style writes are moot
+
+    def load(self) -> bool:
+        self._mesh.model(self.key)
+        return True
+
+    def unload(self) -> None:
+        self._mesh.deregister(self.key)
+
+    def preprocess(self, payload: Any, headers=None) -> Any:
+        return self._mesh.model(self.key).preprocess(payload, headers)
+
+    def predict(self, inputs: Any, headers=None) -> Any:
+        return self._mesh.model(self.key).predict(inputs, headers)
+
+    def postprocess(self, outputs: Any, headers=None) -> Any:
+        return self._mesh.model(self.key).postprocess(outputs, headers)
+
+    async def __call__(self, payload: Any, headers=None) -> Any:
+        return await self._mesh.model(self.key)(payload, headers)

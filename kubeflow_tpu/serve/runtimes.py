@@ -212,7 +212,17 @@ class BertRuntimeModel(JAXModel):
 
 
 def default_registry() -> RuntimeRegistry:
+    from kubeflow_tpu.serve.sklearn_runtime import SklearnRuntimeModel
+
     reg = RuntimeRegistry()
+    reg.register(
+        ServingRuntime(
+            name="kubeflow-tpu-sklearn",
+            supported_formats=("sklearn",),
+            factory=SklearnRuntimeModel,
+            priority=1,
+        )
+    )
     reg.register(
         ServingRuntime(
             name="kubeflow-tpu-bert",

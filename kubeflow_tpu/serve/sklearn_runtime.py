@@ -1,0 +1,160 @@
+"""sklearn-format serving runtime — the non-transformer predictor.
+
+Reference analog: [kserve] python/sklearnserver (SURVEY.md §2.2 "Other
+runtimes" row — UNVERIFIED, mount empty, §0): load a pickled estimator from
+the model dir, answer v1/v2 predict requests. Proves the
+``Model``/``RuntimeRegistry`` abstraction generalizes beyond transformers
+(VERDICT r3 missing #5).
+
+TPU-first split:
+- **Linear-family estimators** (anything exposing ``coef_``/``intercept_``:
+  LinearRegression, Ridge, LogisticRegression, LinearSVC, SGD*) are
+  compiled to a jitted device matmul — decision function on the MXU,
+  argmax-on-device for classifiers, same zero-copy HBM residency as the
+  transformer runtimes.
+- **Everything else** (forests, pipelines, …) serves through the
+  estimator's own ``predict`` on host — correct first; these models are
+  branchy tree walks XLA has no business emulating.
+
+Storage layout (the /mnt/models contract): ``model.joblib`` / ``model.pkl``
+/ any single ``*.joblib``/``*.pkl`` file in the directory, or the file
+itself as ``storage_path``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import numpy as np
+
+from kubeflow_tpu.serve.model import Model
+
+
+def _find_model_file(storage_path: str) -> str:
+    if os.path.isfile(storage_path):
+        return storage_path
+    if os.path.isdir(storage_path):
+        preferred = [
+            os.path.join(storage_path, n)
+            for n in ("model.joblib", "model.pkl", "model.pickle")
+        ]
+        for p in preferred:
+            if os.path.isfile(p):
+                return p
+        candidates = [
+            os.path.join(storage_path, n)
+            for n in sorted(os.listdir(storage_path))
+            if n.endswith((".joblib", ".pkl", ".pickle"))
+        ]
+        if len(candidates) == 1:
+            return candidates[0]
+        if candidates:
+            raise RuntimeError(
+                f"ambiguous sklearn model dir {storage_path!r}: {candidates}"
+            )
+    raise RuntimeError(
+        f"no sklearn model file (*.joblib/*.pkl) under {storage_path!r}"
+    )
+
+
+class SklearnRuntimeModel(Model):
+    """Pickled sklearn estimator behind the standard Model lifecycle."""
+
+    def __init__(self, name: str, storage_path: str | None, **_ignored: Any):
+        super().__init__(name)
+        if storage_path is None:
+            raise ValueError(
+                f"sklearn model {name!r} requires a storage_path"
+            )
+        self._storage_path = storage_path
+        self._estimator = None
+        self._jitted = None       # device path for linear-family models
+        self._classes = None
+
+    # -- lifecycle ---------------------------------------------------------- #
+
+    def load(self) -> bool:
+        path = _find_model_file(self._storage_path)
+        try:
+            import joblib
+
+            est = joblib.load(path)
+        except ImportError:  # joblib ships with sklearn, but stay honest
+            import pickle
+
+            with open(path, "rb") as f:
+                est = pickle.load(f)
+        if not hasattr(est, "predict"):
+            # fail closed: never report ready over a non-estimator pickle
+            raise RuntimeError(
+                f"{path!r} unpickled to {type(est).__name__}, which has no "
+                "predict()"
+            )
+        self._estimator = est
+
+        coef = getattr(est, "coef_", None)
+        intercept = getattr(est, "intercept_", None)
+        classes = getattr(est, "classes_", None)
+        if coef is not None and intercept is not None:
+            # Guard the fast path to OVR/plain-linear shapes: OVO estimators
+            # (SVC(kernel='linear')) expose one coef_ row per class PAIR and
+            # need pairwise voting, not argmax — those serve on host.
+            rows = np.atleast_2d(np.asarray(coef)).shape[0]
+            if classes is not None and rows not in (1, len(classes)):
+                coef = None
+        if coef is not None and intercept is not None:
+            import jax
+            import jax.numpy as jnp
+
+            w = jnp.asarray(np.atleast_2d(np.asarray(coef)).T, jnp.float32)
+            b = jnp.asarray(np.ravel(np.asarray(intercept)), jnp.float32)
+            self._classes = getattr(est, "classes_", None)
+            is_clf = self._classes is not None
+            n_out = w.shape[1]
+
+            def fwd(x):
+                scores = x @ w + b
+                if not is_clf:
+                    return scores[:, 0] if n_out == 1 else scores
+                if n_out == 1:  # binary: one decision column
+                    return (scores[:, 0] > 0).astype(jnp.int32)
+                return jnp.argmax(scores, axis=-1).astype(jnp.int32)
+
+            self._jitted = jax.jit(fwd)
+            # weights → HBM once, compile the forward
+            _ = np.asarray(self._jitted(jnp.zeros((1, w.shape[0]), jnp.float32)))
+        self.ready = True
+        return True
+
+    def unload(self) -> None:
+        self._estimator = None
+        self._jitted = None
+        self.ready = False
+
+    # -- data path ----------------------------------------------------------- #
+
+    def preprocess(self, payload: Any, headers: Mapping[str, str] | None = None):
+        if isinstance(payload, Mapping) and isinstance(payload.get("inputs"), Mapping):
+            tensors = payload["inputs"]
+            arr = np.asarray(next(iter(tensors.values())), np.float32)
+        elif isinstance(payload, Mapping) and "instances" in payload:
+            arr = np.asarray(payload["instances"], np.float32)
+        else:
+            arr = np.asarray(payload, np.float32)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if arr.ndim != 2:
+            raise ValueError(f"expected (batch, features); got {arr.shape}")
+        return arr
+
+    def predict(self, inputs: np.ndarray, headers=None) -> np.ndarray:
+        if self._jitted is not None:
+            out = np.asarray(self._jitted(inputs))
+            if self._classes is not None:
+                return np.asarray(self._classes)[out]
+            return out
+        return np.asarray(self._estimator.predict(inputs))
+
+    def postprocess(self, outputs: np.ndarray, headers=None) -> Any:
+        return {"predictions": outputs.tolist()}

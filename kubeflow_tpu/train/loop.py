@@ -48,6 +48,26 @@ class TrainConfig:
     resume: bool = True
     metrics_logdir: str | None = None
     donate_state: bool = True
+    #: numerics discipline (SURVEY.md §5.2):
+    #: - "metrics"  (default): the MetricWriter raises NonFiniteMetricError
+    #:   the first time a logged metric is NaN/inf — zero overhead on the
+    #:   hot path, detection within log_every steps.
+    #: - "checkify": every step runs under jax.experimental.checkify
+    #:   float_checks — the raise names the exact op and source line that
+    #:   produced the first NaN/inf, at ~2x step cost. For debugging runs.
+    #: - "off": no checks (bench/microbenchmark mode).
+    check_numerics: str = "metrics"
+    #: sets jax_debug_nans for the whole process (eager-level NaN isolation;
+    #: orthogonal to checkify — use when the NaN is outside the step).
+    debug_nans: bool = False
+
+    def __post_init__(self) -> None:
+        if self.check_numerics not in ("off", "metrics", "checkify"):
+            # a typo here must not silently degrade to default behavior
+            raise ValueError(
+                f"check_numerics={self.check_numerics!r}; expected "
+                "'off', 'metrics', or 'checkify'"
+            )
 
 
 class Trainer:
@@ -162,6 +182,22 @@ class Trainer:
             return new_state, metrics
 
         state_shardings = self._state_sharding
+        if self.config.check_numerics == "checkify":
+            from jax.experimental import checkify
+
+            # No donation and inferred shardings: a failed step must leave
+            # the caller's state alive so the error can be reported and the
+            # run resumed from checkpoint.
+            checked = jax.jit(
+                checkify.checkify(step, errors=checkify.float_checks)
+            )
+
+            def run(state: TrainState, batch):
+                err, out = checked(state, batch)
+                checkify.check_error(err)  # located: op + source line
+                return out
+
+            return run
         return jax.jit(
             step,
             in_shardings=(state_shardings, self.batch_sharding),
@@ -199,9 +235,13 @@ class Trainer:
         """
         cfg = self.config
         per_device_batch(cfg.global_batch, cfg.mesh)  # validate divisibility
+        if cfg.debug_nans:
+            jax.config.update("jax_debug_nans", True)
         own_writer = writer is None
         writer = writer or MetricWriter(
-            cfg.metrics_logdir, is_writer=jax.process_index() == 0
+            cfg.metrics_logdir,
+            is_writer=jax.process_index() == 0,
+            nan_alarm=cfg.check_numerics != "off",
         )
 
         # Liveness: when launched by the orchestrator, beat automatically so

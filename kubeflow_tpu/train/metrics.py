@@ -11,10 +11,19 @@ path of the reference collector).
 from __future__ import annotations
 
 import json
+import math
 import sys
 import time
 from pathlib import Path
 from typing import Any, IO, Mapping
+
+
+class NonFiniteMetricError(RuntimeError):
+    """A training metric went NaN/inf — fail fast, don't train into noise.
+
+    SURVEY.md §5.2 (numerics discipline): the reference relies on user-side
+    vigilance; here the metric writer itself is the alarm, so every trainer
+    and tuner trial gets it for free."""
 
 #: stdout format, one line per step: ``step=3 loss=1.23 accuracy=0.9``
 #: (floats rendered with repr-precision; scrapers parse ``(\w+)=([^ ]+)``).
@@ -30,8 +39,12 @@ class MetricWriter:
         is_writer: bool = True,
         stdout: IO[str] | None = None,
         tensorboard: bool = False,
+        nan_alarm: bool = True,
     ):
         self.is_writer = is_writer
+        #: raise NonFiniteMetricError on NaN/inf metrics — on EVERY rank
+        #: (a poisoned loss replicates; non-writer ranks must stop too)
+        self.nan_alarm = nan_alarm
         self.logdir = Path(logdir) if logdir else None
         self._stdout = stdout or sys.stdout
         self._jsonl: IO[str] | None = None
@@ -50,9 +63,19 @@ class MetricWriter:
                 self._tb = None
 
     def write(self, step: int, metrics: Mapping[str, Any]) -> None:
+        # one device sync per metric: _to_scalar blocks on device arrays, so
+        # convert once and share between the alarm and the sinks
+        scalars = {k: _to_scalar(v) for k, v in metrics.items()}
+        if self.nan_alarm:
+            bad = {k: v for k, v in scalars.items() if not math.isfinite(v)}
+            if bad:
+                raise NonFiniteMetricError(
+                    f"non-finite metrics at step {step}: {bad} — a batch or "
+                    "the optimizer state is poisoned; enable "
+                    "TrainConfig.check_numerics='checkify' to locate the op"
+                )
         if not self.is_writer:
             return
-        scalars = {k: _to_scalar(v) for k, v in metrics.items()}
         line = " ".join(
             [f"step={step}"] + [f"{k}={v:.6g}" for k, v in scalars.items()]
         )

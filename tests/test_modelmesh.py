@@ -1,0 +1,268 @@
+"""ModelMesh-class multi-model density (VERDICT r3 missing #3; SURVEY.md
+§2.2 ModelMesh row): N models under one HBM budget — on-demand load, LRU
+eviction, per-model readiness, fail-closed loads, controller placement."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serve.model import BucketSpec, JAXModel
+from kubeflow_tpu.serve.modelmesh import (
+    MeshBackedModel,
+    ModelMesh,
+    ModelState,
+)
+
+
+def _jax_model(name: str, d: int = 32):
+    """A real JAXModel with measurable device-resident params (d*d f32)."""
+    import jax.numpy as jnp
+
+    def apply_fn(params, ids, mask):
+        emb = params["w"][ids % params["w"].shape[0]]
+        return emb.sum(-1, keepdims=True) + mask[..., None].astype(jnp.float32)
+
+    def init_params():
+        return {"w": jnp.ones((d, d), jnp.float32)}
+
+    return JAXModel(
+        name, apply_fn, init_params,
+        buckets=BucketSpec(batch_sizes=(1, 2), seq_lens=(8,)),
+    )
+
+
+PER_MODEL = 32 * 32 * 4  # bytes of the test model's params
+
+
+def test_lazy_registration_costs_no_hbm():
+    mesh = ModelMesh(hbm_budget_bytes=2 * PER_MODEL + 64)
+    for i in range(8):
+        mesh.register(f"m{i}", lambda i=i: _jax_model(f"m{i}"))
+    assert mesh.resident() == [] and mesh.resident_bytes() == 0
+    assert mesh.names() == [f"m{i}" for i in range(8)]
+    assert mesh.readiness("m3")["state"] == ModelState.REGISTERED
+
+
+def test_lru_eviction_under_budget():
+    t = [0.0]
+    mesh = ModelMesh(2 * PER_MODEL + 64, clock=lambda: t[0])
+    for i in range(3):
+        mesh.register(f"m{i}", lambda i=i: _jax_model(f"m{i}"))
+
+    t[0] = 1.0
+    mesh.model("m0")
+    t[0] = 2.0
+    mesh.model("m1")
+    assert mesh.resident() == ["m0", "m1"]
+    # touch m0 so m1 becomes LRU
+    t[0] = 3.0
+    mesh.model("m0")
+    t[0] = 4.0
+    mesh.model("m2")  # must evict m1, not m0
+    assert mesh.resident() == ["m0", "m2"]
+    assert mesh.stats["evictions"] == 1
+    assert mesh.readiness("m1")["state"] == ModelState.REGISTERED
+    # evicted model reloads on demand (evicting the new LRU, m0)
+    t[0] = 5.0
+    mesh.model("m1")
+    assert mesh.resident() == ["m1", "m2"]
+    assert mesh.stats["evictions"] == 2
+    assert mesh.stats["loads"] == 4  # m0, m1, m2, m1-again
+
+
+def test_model_larger_than_budget_fails_closed():
+    mesh = ModelMesh(PER_MODEL // 2)
+    mesh.register("big", lambda: _jax_model("big"))
+    with pytest.raises(RuntimeError, match="budget"):
+        mesh.model("big")
+    assert mesh.readiness("big")["state"] == ModelState.FAILED
+    assert mesh.resident() == []
+
+
+def test_broken_factory_fails_only_its_model():
+    mesh = ModelMesh(4 * PER_MODEL)
+    mesh.register("ok", lambda: _jax_model("ok"))
+
+    def boom():
+        raise OSError("corrupt checkpoint")
+
+    mesh.register("bad", boom)
+    with pytest.raises(RuntimeError, match="corrupt checkpoint"):
+        mesh.model("bad")
+    assert mesh.readiness("bad")["state"] == ModelState.FAILED
+    mesh.model("ok")  # neighbour unaffected
+    assert mesh.resident() == ["ok"]
+
+
+def test_unknown_model_is_keyerror():
+    mesh = ModelMesh(PER_MODEL)
+    with pytest.raises(KeyError):
+        mesh.model("ghost")
+
+
+def test_mesh_backed_model_serves_through_dataplane():
+    """The DataPlane path (what REST/gRPC call) works over mesh proxies,
+    with density maintained across requests."""
+    from kubeflow_tpu.serve.server import DataPlane
+
+    mesh = ModelMesh(2 * PER_MODEL + 64)
+    dp = DataPlane()
+    for i in range(3):
+        dp.register(
+            MeshBackedModel(mesh, f"m{i}", lambda i=i: _jax_model(f"m{i}"))
+        )
+
+    async def run():
+        for i in (0, 1, 2, 0):
+            out = await dp.infer(f"m{i}", {"instances": [[1, 2, 3]]})
+            assert np.asarray(out["predictions"]).shape[0] == 1
+        assert len(mesh.resident()) <= 2
+        assert mesh.stats["evictions"] >= 1
+
+    asyncio.run(run())
+
+
+def test_controller_places_services_onto_mesh():
+    """serve/controller.py placement: N InferenceServices share the budget;
+    readiness reported per model; routing pulls models in on demand."""
+    from kubeflow_tpu.serve.controller import InferenceServiceController
+    from kubeflow_tpu.serve.spec import (
+        InferenceServiceSpec,
+        PredictorSpec,
+        RuntimeRegistry,
+        ServingRuntime,
+    )
+
+    reg = RuntimeRegistry()
+    reg.register(
+        ServingRuntime(
+            name="toy",
+            supported_formats=("toy",),
+            factory=lambda name, path, **kw: _jax_model(name),
+            priority=1,
+        )
+    )
+    mesh = ModelMesh(2 * PER_MODEL + 64)
+    ctl = InferenceServiceController(reg, model_mesh=mesh)
+    for i in range(3):
+        ctl.apply(
+            InferenceServiceSpec(
+                name=f"svc{i}",
+                predictor=PredictorSpec(model_format="toy"),
+            )
+        )
+    # registration is lazy: nothing resident yet
+    assert mesh.resident() == []
+    for i in (0, 1, 2):
+        m = ctl.route(f"svc{i}")
+        out = m.predict(m.preprocess({"instances": [[5, 6]]}))
+        assert out.shape[0] == 1
+    assert len(mesh.resident()) <= 2
+    assert mesh.stats["evictions"] >= 1
+    # deleting a service frees its registration
+    ctl.delete("svc1")
+    assert "svc1" not in mesh.names()
+
+
+def test_failed_load_recovers_after_cooldown():
+    """A transient load failure is NOT a permanent 503: FAILED rejects
+    during the cooldown, then the next request retries and succeeds."""
+    t = [0.0]
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise OSError("transient storage flake")
+        return _jax_model("f")
+
+    mesh = ModelMesh(4 * PER_MODEL, clock=lambda: t[0], retry_cooldown_s=5.0)
+    proxy = MeshBackedModel(mesh, "f", flaky)
+    with pytest.raises(RuntimeError, match="transient"):
+        mesh.model("f")
+    t[0] = 1.0
+    assert not proxy.ready  # inside cooldown: data plane 503s fast
+    with pytest.raises(RuntimeError, match="retry in"):
+        mesh.model("f")
+    assert attempts["n"] == 1  # cooldown prevented a reload storm
+    t[0] = 6.0
+    assert proxy.ready  # cooldown over: requests may retry
+    mesh.model("f")
+    assert mesh.resident() == ["f"]
+
+
+def test_rollout_replaces_model_without_bricking_service():
+    """VERDICT-fix regression: a 100% rollout must serve the NEW model and
+    the old entry's unload must not take the new registration down."""
+    from kubeflow_tpu.serve.controller import InferenceServiceController
+    from kubeflow_tpu.serve.spec import (
+        InferenceServiceSpec,
+        PredictorSpec,
+        RuntimeRegistry,
+        ServingRuntime,
+    )
+
+    built = []
+
+    def factory(name, path, **kw):
+        built.append(kw.get("flavor", "v1"))
+        return _jax_model(name)
+
+    reg = RuntimeRegistry()
+    reg.register(
+        ServingRuntime(
+            name="toy", supported_formats=("toy",), factory=factory, priority=1
+        )
+    )
+    mesh = ModelMesh(8 * PER_MODEL)
+    ctl = InferenceServiceController(reg, model_mesh=mesh)
+
+    def spec(flavor):
+        return InferenceServiceSpec(
+            name="svc",
+            predictor=PredictorSpec(
+                model_format="toy",
+                canary_traffic_percent=100,
+                extra={"flavor": flavor},
+            ),
+        )
+
+    ctl.apply(spec("v1"))
+    m1 = ctl.route("svc")
+    m1.predict(m1.preprocess({"instances": [[1]]}))
+    assert built == ["v1"]
+
+    ctl.apply(spec("v2"))  # plain rollout: replaces default outright
+    m2 = ctl.route("svc")
+    out = m2.predict(m2.preprocess({"instances": [[1]]}))
+    assert out.shape[0] == 1
+    assert built == ["v1", "v2"], built  # the NEW factory actually ran
+    assert m2.ready
+
+
+def test_concurrent_loads_serialize_within_budget():
+    import threading
+
+    mesh = ModelMesh(2 * PER_MODEL + 64)
+    for i in range(2):
+        mesh.register(f"m{i}", lambda i=i: _jax_model(f"m{i}"))
+    errs = []
+
+    def hit(name):
+        try:
+            for _ in range(5):
+                mesh.model(name)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=hit, args=(f"m{i % 2}",)) for i in range(6)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    assert mesh.resident_bytes() <= mesh.budget
+    assert mesh.stats["loads"] == 2  # one load per model, no double-loads
