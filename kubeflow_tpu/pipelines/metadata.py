@@ -100,6 +100,36 @@ class LineageStore:
 
     # -- query path ---------------------------------------------------- #
 
+    def runs(self) -> list[dict]:
+        """All pipeline runs with task-state rollups (the frontend's run
+        list — SURVEY.md §2.4 Frontend row)."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT run_id, COUNT(*),"
+                " SUM(state='Succeeded'), SUM(state='Failed'),"
+                " SUM(cache_hit), MIN(started), MAX(finished)"
+                " FROM executions GROUP BY run_id ORDER BY MIN(started) DESC"
+            ).fetchall()
+        out = []
+        for run_id, total, ok, failed, cached, started, finished in rows:
+            state = (
+                "Failed" if failed else
+                "Succeeded" if ok == total else "Running"
+            )
+            out.append(
+                {
+                    "run_id": run_id,
+                    "state": state,
+                    "tasks": total,
+                    "succeeded": ok or 0,
+                    "failed": failed or 0,
+                    "cache_hits": cached or 0,
+                    "started": started,
+                    "finished": finished,
+                }
+            )
+        return out
+
     def executions(self, run_id: str) -> list[dict]:
         with self._lock:
             rows = self._db.execute(

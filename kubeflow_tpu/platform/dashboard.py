@@ -1,16 +1,26 @@
-"""Central dashboard: one aggregated status API over every plane.
+"""Central dashboard: aggregated UI + CRUD API over every plane.
 
 The reference's central dashboard is a web shell aggregating the component
-UIs (SURVEY.md §2.5). The TPU control plane's equivalent is the data half:
-a JSON API (aiohttp on a daemon thread, the serving plane's stack) that
-aggregates jobs, profiles/quotas, notebooks, and tensorboards so any
-frontend — or ``curl`` — can see the whole platform at once.
+UIs, and its CRUD web apps (jupyter / tensorboards) are the writable
+frontends (SURVEY.md §2.5). Here both collapse into one server: a JSON API
+plus a self-contained HTML single-page UI (``GET /``) that renders and
+drives it — no build toolchain, works in any browser or through ``curl``.
 
+Read API:
 - ``GET /api/summary``      → counts per plane + fleet snapshot
 - ``GET /api/jobs``         → job list (phase, kind, replicas, restarts)
+- ``GET /api/jobs/{uid}/logs?replica=&index=`` → worker logs
 - ``GET /api/profiles``     → profiles with live quota usage
 - ``GET /api/notebooks``    → notebook phases + idle times
 - ``GET /api/tensorboards`` → board phases + urls
+
+CRUD (the web-app analog):
+- ``POST /api/jobs``              body = CRD manifest (any known kind)
+- ``DELETE /api/jobs/{uid}``
+- ``POST /api/notebooks``         {name, command?, culling_idle_seconds?}
+- ``DELETE /api/notebooks/{name}``
+- ``POST /api/tensorboards``      {name, logdir}
+- ``DELETE /api/tensorboards/{name}``
 """
 
 from __future__ import annotations
@@ -25,6 +35,14 @@ from kubeflow_tpu.platform.profiles import ProfileController, job_chips
 from kubeflow_tpu.platform.tensorboards import TensorboardController
 
 
+async def _json(data):
+    from aiohttp import web
+
+    return web.json_response(
+        data, dumps=lambda d: json.dumps(d, default=str)
+    )
+
+
 class DashboardServer(ThreadedAiohttpServer):
     thread_name = "kft-dashboard"
 
@@ -35,6 +53,8 @@ class DashboardServer(ThreadedAiohttpServer):
         profiles: ProfileController | None = None,
         notebooks: NotebookController | None = None,
         tensorboards: TensorboardController | None = None,
+        tune_db=None,       # tune.db.TrialDB → /api/experiments (Katib UI)
+        lineage=None,       # pipelines.metadata.LineageStore → /api/pipelines
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -43,6 +63,8 @@ class DashboardServer(ThreadedAiohttpServer):
         self.profiles = profiles
         self.notebooks = notebooks
         self.tensorboards = tensorboards
+        self.tune_db = tune_db
+        self.lineage = lineage
 
     # -- views ---------------------------------------------------------- #
 
@@ -111,6 +133,29 @@ class DashboardServer(ThreadedAiohttpServer):
             for spec, status in self.tensorboards.statuses()
         ]
 
+    def experiments_view(self) -> list[dict]:
+        return [] if self.tune_db is None else self.tune_db.experiments()
+
+    def experiment_trials_view(self, name: str) -> list[dict]:
+        if self.tune_db is None:
+            return []
+        return [
+            {
+                "trial_id": t.assignment.trial_id,
+                "parameters": t.assignment.parameters,
+                "state": t.state.value,
+                "metrics": t.metrics,
+                "message": t.message,
+            }
+            for t in self.tune_db.load_trials(name)
+        ]
+
+    def pipelines_view(self) -> list[dict]:
+        return [] if self.lineage is None else self.lineage.runs()
+
+    def pipeline_tasks_view(self, run_id: str) -> list[dict]:
+        return [] if self.lineage is None else self.lineage.executions(run_id)
+
     def summary_view(self) -> dict:
         jobs = self.jobs_view()
         phases: dict[str, int] = {}
@@ -121,6 +166,8 @@ class DashboardServer(ThreadedAiohttpServer):
             "profiles": len(self.profiles_view()),
             "notebooks": len(self.notebooks_view()),
             "tensorboards": len(self.tensorboards_view()),
+            "experiments": len(self.experiments_view()),
+            "pipeline_runs": len(self.pipelines_view()),
             "fleet": {
                 "slices": len(self.cluster.fleet.snapshot()),
                 "total_chips": self.cluster.fleet.total_chips(),
@@ -142,11 +189,244 @@ class DashboardServer(ThreadedAiohttpServer):
 
             return h
 
+        def guard(coro):
+            async def h(request):
+                try:
+                    return await coro(request)
+                except KeyError as e:
+                    raise web.HTTPNotFound(reason=str(e))
+                except (ValueError, TypeError) as e:
+                    raise web.HTTPBadRequest(reason=str(e))
+
+            return h
+
+        # ---- CRUD: jobs ------------------------------------------------ #
+
+        async def create_job(request):
+            from kubeflow_tpu.platform.manifests import parse
+
+            manifest = await request.json()
+            spec = parse(manifest)
+            uid = self.cluster.submit(spec)
+            return web.json_response({"uid": uid, "name": spec.name})
+
+        async def delete_job(request):
+            uid = request.match_info["uid"]
+            if self.cluster.get(uid) is None:
+                raise KeyError(uid)
+            self.cluster.delete(uid)
+            return web.json_response({"deleted": uid})
+
+        async def job_logs(request):
+            uid = request.match_info["uid"]
+            replica = request.query.get("replica", "worker")
+            index = int(request.query.get("index", 0))
+            return web.Response(text=self.cluster.logs(uid, replica, index))
+
+        # ---- CRUD: notebooks (jupyter web-app analog) ------------------ #
+
+        import re
+
+        def valid_name(name) -> str:
+            # names become job names and workdir path components; reject
+            # anything that could escape a directory or break a shell/html
+            # context before it enters the system (DNS-1123-ish)
+            if not isinstance(name, str) or not re.fullmatch(
+                r"[a-z0-9]([a-z0-9._-]{0,62}[a-z0-9])?", name
+            ):
+                raise ValueError(
+                    f"invalid name {name!r}: want lowercase alphanumerics "
+                    "with inner '.', '_' or '-', max 64 chars"
+                )
+            return name
+
+        async def create_notebook(request):
+            import sys
+
+            from kubeflow_tpu.platform.notebooks import NotebookSpec
+
+            if self.notebooks is None:
+                raise ValueError("notebook controller not attached")
+            body = await request.json()
+            spec = NotebookSpec(
+                name=valid_name(body["name"]),
+                command=tuple(
+                    body.get("command")
+                    or (sys.executable, "-c", "import time; time.sleep(3600)")
+                ),
+                namespace=body.get("namespace", "default"),
+                culling_idle_seconds=body.get("culling_idle_seconds"),
+            )
+            st = self.notebooks.create(spec)
+            return web.json_response({"name": spec.name, "phase": st.phase})
+
+        async def delete_notebook(request):
+            if self.notebooks is None:
+                raise ValueError("notebook controller not attached")
+            self.notebooks.delete(request.match_info["name"])
+            return web.json_response({"deleted": request.match_info["name"]})
+
+        # ---- CRUD: tensorboards ---------------------------------------- #
+
+        async def create_tensorboard(request):
+            from kubeflow_tpu.platform.tensorboards import TensorboardSpec
+
+            if self.tensorboards is None:
+                raise ValueError("tensorboard controller not attached")
+            body = await request.json()
+            st = self.tensorboards.create(
+                TensorboardSpec(
+                    name=valid_name(body["name"]), logdir=body["logdir"]
+                )
+            )
+            return web.json_response({"name": body["name"], "url": st.url})
+
+        async def delete_tensorboard(request):
+            if self.tensorboards is None:
+                raise ValueError("tensorboard controller not attached")
+            self.tensorboards.delete(request.match_info["name"])
+            return web.json_response({"deleted": request.match_info["name"]})
+
+        async def index(request):
+            return web.Response(text=_INDEX_HTML, content_type="text/html")
+
         app = web.Application()
+        app.router.add_get("/", index)
         app.router.add_get("/api/summary", handler(self.summary_view))
         app.router.add_get("/api/jobs", handler(self.jobs_view))
         app.router.add_get("/api/profiles", handler(self.profiles_view))
         app.router.add_get("/api/notebooks", handler(self.notebooks_view))
         app.router.add_get("/api/tensorboards", handler(self.tensorboards_view))
+        app.router.add_get("/api/experiments", handler(self.experiments_view))
+        app.router.add_get(
+            "/api/experiments/{name}/trials",
+            guard(
+                lambda r: _json(
+                    self.experiment_trials_view(r.match_info["name"])
+                )
+            ),
+        )
+        app.router.add_get("/api/pipelines", handler(self.pipelines_view))
+        app.router.add_get(
+            "/api/pipelines/{run_id}/tasks",
+            guard(
+                lambda r: _json(
+                    self.pipeline_tasks_view(r.match_info["run_id"])
+                )
+            ),
+        )
+        app.router.add_post("/api/jobs", guard(create_job))
+        app.router.add_delete("/api/jobs/{uid}", guard(delete_job))
+        app.router.add_get("/api/jobs/{uid}/logs", guard(job_logs))
+        app.router.add_post("/api/notebooks", guard(create_notebook))
+        app.router.add_delete("/api/notebooks/{name}", guard(delete_notebook))
+        app.router.add_post("/api/tensorboards", guard(create_tensorboard))
+        app.router.add_delete(
+            "/api/tensorboards/{name}", guard(delete_tensorboard)
+        )
         return app
+
+
+#: Self-contained SPA: fetches the JSON APIs, renders tables, drives CRUD.
+#: Vanilla HTML+JS on purpose — the reference's Angular/TS frontends need a
+#: build pipeline; a control-plane UI needs none (SURVEY.md §2.5).
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>kubeflow-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1f2328}
+ header{background:#1a2b4c;color:#fff;padding:10px 18px;display:flex;gap:18px;align-items:baseline}
+ header h1{font-size:16px;margin:0}
+ nav button{background:none;border:none;color:#cdd6e4;font-size:14px;cursor:pointer;padding:4px 8px}
+ nav button.on{color:#fff;border-bottom:2px solid #6cf}
+ main{padding:16px 18px;max-width:1100px}
+ table{border-collapse:collapse;width:100%;background:#fff;font-size:13px}
+ th,td{text-align:left;padding:6px 10px;border-bottom:1px solid #e4e7ec}
+ th{background:#eef1f5;font-weight:600}
+ .pill{padding:1px 8px;border-radius:10px;font-size:12px;background:#e4e7ec}
+ .Succeeded{background:#d7f5dd}.Running{background:#d7e9f9}
+ .Failed,.FailedToLoad{background:#fadcd9}.Pending{background:#faf0d2}
+ .cards{display:flex;gap:12px;margin-bottom:16px;flex-wrap:wrap}
+ .card{background:#fff;border:1px solid #e4e7ec;border-radius:8px;padding:10px 16px;min-width:110px}
+ .card b{font-size:22px;display:block}
+ .bar{margin:10px 0}
+ input,select{padding:4px 6px;margin-right:6px}
+ button.act{cursor:pointer;padding:3px 10px}
+ pre{background:#101418;color:#d6e2f0;padding:10px;overflow:auto;max-height:320px}
+</style></head><body>
+<header><h1>kubeflow-tpu</h1><nav id="nav"></nav></header>
+<main id="main"></main>
+<script>
+const tabs=["summary","jobs","experiments","pipelines","notebooks","tensorboards","profiles"];
+let tab="summary";
+const $=(h)=>{const d=document.createElement("div");d.innerHTML=h;return d};
+const esc=(s)=>String(s).replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+async function j(url,opt){const r=await fetch(url,opt);if(!r.ok)throw new Error(await r.text());
+ const ct=r.headers.get("content-type")||"";return ct.includes("json")?r.json():r.text()}
+function nav(){document.getElementById("nav").innerHTML=tabs.map(t=>
+ `<button class="${t===tab?'on':''}" onclick="go('${t}')">${t}</button>`).join("")}
+function go(t){tab=t;render()}
+function pill(p){return raw(`<span class="pill ${esc(p)}">${esc(p)}</span>`)}
+// escape by DEFAULT: server data (job/notebook names…) is untrusted in the
+// browser; only values wrapped in raw() render as HTML
+function raw(h){return {__html:h}}
+function cell(v){return v&&v.__html!==undefined?v.__html:esc(v??"")}
+function uenc(s){return esc(encodeURIComponent(s))}
+function table(rows,cols,actions){if(!rows.length)return "<p>none</p>";
+ return `<table><tr>${cols.map(c=>`<th>${esc(c)}</th>`).join("")}${actions?"<th></th>":""}</tr>`+
+ rows.map(r=>`<tr>${cols.map(c=>`<td>${cell(r[c])}</td>`).join("")}${actions?`<td>${actions(r)}</td>`:""}</tr>`).join("")+"</table>"}
+async function render(){nav();const m=document.getElementById("main");m.textContent="loading…";
+ try{
+ if(tab==="summary"){const s=await j("/api/summary");
+  m.innerHTML=`<div class="cards">
+   <div class="card"><b>${s.jobs.total}</b>jobs</div>
+   <div class="card"><b>${s.fleet.free_chips}/${s.fleet.total_chips}</b>free chips</div>
+   <div class="card"><b>${s.fleet.slices}</b>slices</div>
+   <div class="card"><b>${s.notebooks}</b>notebooks</div>
+   <div class="card"><b>${s.tensorboards}</b>tensorboards</div></div>
+   <h3>jobs by phase</h3>`+table(Object.entries(s.jobs.by_phase).map(([k,v])=>({phase:pill(k),count:v})),["phase","count"])}
+ if(tab==="jobs"){const rows=(await j("/api/jobs")).map(r=>({...r,phase:pill(r.phase),
+   replicas:JSON.stringify(r.replicas)}));
+  m.innerHTML=`<div class="bar"><i>POST /api/jobs with a CRD manifest to submit</i></div>`+
+   table(rows,["name","kind","phase","chips","restarts","uid"],
+    r=>`<button class="act" onclick="logs('${uenc(r.uid)}')">logs</button>
+        <button class="act" onclick="del('/api/jobs/${uenc(r.uid)}')">delete</button>`)+`<pre id="logs" hidden></pre>`}
+ if(tab==="experiments"){const rows=(await j("/api/experiments")).map(r=>({...r,
+   name:raw(`<a href="#" onclick="trials('${uenc(r.name)}');return false">${esc(r.name)}</a>`)}));
+  m.innerHTML=table(rows,["name","trials","succeeded","failed","running"])+`<pre id="detail" hidden></pre>`}
+ if(tab==="pipelines"){const rows=(await j("/api/pipelines")).map(r=>({...r,state:pill(r.state),
+   run_id:raw(`<a href="#" onclick="tasks('${uenc(r.run_id)}');return false">${esc(r.run_id)}</a>`)}));
+  m.innerHTML=table(rows,["run_id","state","tasks","succeeded","failed","cache_hits"])+`<pre id="detail" hidden></pre>`}
+ if(tab==="notebooks"){const rows=(await j("/api/notebooks")).map(r=>({...r,phase:pill(r.phase)}));
+  m.innerHTML=`<div class="bar"><input id="nb" placeholder="name">
+    <button class="act" onclick="mknb()">create notebook</button></div>`+
+   table(rows,["name","namespace","phase","idle_seconds"],
+    r=>`<button class="act" onclick="del('/api/notebooks/${uenc(r.name)}')">delete</button>`)}
+ if(tab==="tensorboards"){const rows=(await j("/api/tensorboards")).map(r=>({...r,phase:pill(r.phase),
+   url:raw(`<a href="${esc(r.url)}">${esc(r.url)}</a>`)}));
+  m.innerHTML=`<div class="bar"><input id="tbn" placeholder="name"><input id="tbl" placeholder="logdir">
+    <button class="act" onclick="mktb()">create tensorboard</button></div>`+
+   table(rows,["name","phase","url","logdir"],
+    r=>`<button class="act" onclick="del('/api/tensorboards/${uenc(r.name)}')">delete</button>`)}
+ if(tab==="profiles"){const rows=(await j("/api/profiles")).map(r=>({name:r.name,owner:r.owner,
+   quota:JSON.stringify(r.quota),usage:JSON.stringify(r.usage)}));
+  m.innerHTML=table(rows,["name","owner","quota","usage"])}
+ }catch(e){m.innerHTML=`<pre>${esc(e.message||e)}</pre>`}}
+async function del(url){await j(url,{method:"DELETE"});render()}
+async function logs(uid){const p=document.getElementById("logs");p.hidden=false;
+ p.textContent=await j(`/api/jobs/${uid}/logs`)}
+async function trials(name){const p=document.getElementById("detail");p.hidden=false;
+ p.textContent=JSON.stringify(await j(`/api/experiments/${name}/trials`),null,1)}
+async function tasks(run){const p=document.getElementById("detail");p.hidden=false;
+ p.textContent=JSON.stringify(await j(`/api/pipelines/${run}/tasks`),null,1)}
+async function mknb(){await j("/api/notebooks",{method:"POST",
+ headers:{"content-type":"application/json"},
+ body:JSON.stringify({name:document.getElementById("nb").value})});render()}
+async function mktb(){await j("/api/tensorboards",{method:"POST",
+ headers:{"content-type":"application/json"},
+ body:JSON.stringify({name:document.getElementById("tbn").value,
+  logdir:document.getElementById("tbl").value})});render()}
+setInterval(()=>{if(!document.hidden)render()},5000);
+render();
+</script></body></html>
+"""
 

@@ -1,0 +1,263 @@
+"""Manifest overlay/layering — the kustomize config plane.
+
+Reference analog: the [manifests] repo (SURVEY.md §1 L8, §2.5 "Manifests"
+row — UNVERIFIED, mount empty, §0): every Kubeflow deployment is
+``kustomize build`` over bases + overlays (namePrefix, commonLabels,
+patchesStrategicMerge, configMapGenerator). This module implements that
+layering for OUR manifest dialect, so one base job/service definition
+ships with per-environment overlays exactly like the reference's
+``overlays/{dev,prod}`` trees.
+
+Supported kustomization fields (the load-bearing core of kustomize):
+
+- ``resources``: manifest files, directories of manifests, or nested
+  kustomization directories (recursive bases — an overlay's resource can
+  itself be an overlay).
+- ``namePrefix`` / ``nameSuffix`` / ``namespace``
+- ``commonLabels`` / ``commonAnnotations``
+- ``patchesStrategicMerge``: inline dicts or files; deep-merges objects,
+  merges lists of named objects by ``name`` (the strategic-merge
+  patchMergeKey), replaces other lists; an explicit ``null`` deletes the
+  key (JSON-merge-patch convention).
+- ``patches`` with ``target`` selectors (kind/name match) — one patch
+  aimed at a subset of resources.
+- ``configMapGenerator``: literals → ConfigMap manifests.
+
+``build()`` returns fully-resolved manifest dicts; ``parse()`` routes a
+built manifest to its typed spec (JobSpec / InferenceServiceSpec /
+ExperimentSpec) so ``build → parse → submit`` is the `kubectl apply -k`
+path of this framework.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from typing import Any, Mapping
+
+import yaml
+
+KUSTOMIZATION_NAMES = ("kustomization.yaml", "kustomization.yml")
+
+#: strategic-merge list key (kustomize's default patchMergeKey)
+MERGE_KEY = "name"
+
+
+# --------------------------------------------------------------------------- #
+# strategic merge
+# --------------------------------------------------------------------------- #
+
+
+def strategic_merge(base: Any, patch: Any) -> Any:
+    """kustomize-style strategic merge of ``patch`` onto ``base``."""
+    if isinstance(patch, Mapping) and isinstance(base, Mapping):
+        out = dict(base)
+        for k, v in patch.items():
+            if v is None:
+                out.pop(k, None)  # null deletes (JSON merge patch)
+            elif k in out:
+                out[k] = strategic_merge(out[k], v)
+            else:
+                out[k] = copy.deepcopy(v)
+        return out
+    if isinstance(patch, list) and isinstance(base, list):
+        # lists of named objects merge by MERGE_KEY; everything else replaces
+        if all(isinstance(x, Mapping) and MERGE_KEY in x for x in base + patch):
+            merged = {x[MERGE_KEY]: copy.deepcopy(x) for x in base}
+            for p in patch:
+                key = p[MERGE_KEY]
+                if key in merged:
+                    merged[key] = strategic_merge(merged[key], p)
+                else:
+                    merged[key] = copy.deepcopy(p)
+            return list(merged.values())
+        return copy.deepcopy(patch)
+    return copy.deepcopy(patch)
+
+
+def _matches(target: Mapping[str, Any], manifest: Mapping[str, Any]) -> bool:
+    meta = manifest.get("metadata", {})
+    for field, actual in (
+        ("kind", manifest.get("kind")),
+        ("name", meta.get("name")),
+        ("namespace", meta.get("namespace")),
+    ):
+        want = target.get(field)
+        if want is not None and want != actual:
+            return False
+    return True
+
+
+# --------------------------------------------------------------------------- #
+# kustomization loading
+# --------------------------------------------------------------------------- #
+
+
+def _load_yaml_docs(path: str) -> list[dict]:
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def _is_kustomization_dir(path: str) -> bool:
+    return os.path.isdir(path) and any(
+        os.path.isfile(os.path.join(path, n)) for n in KUSTOMIZATION_NAMES
+    )
+
+
+def _load_resources(entry: str, base_dir: str) -> list[dict]:
+    path = entry if os.path.isabs(entry) else os.path.join(base_dir, entry)
+    if _is_kustomization_dir(path):
+        return build(path)  # recursive base/overlay
+    if os.path.isdir(path):
+        out: list[dict] = []
+        for name in sorted(os.listdir(path)):
+            if name.endswith((".yaml", ".yml")) and name not in KUSTOMIZATION_NAMES:
+                out.extend(_load_yaml_docs(os.path.join(path, name)))
+        return out
+    if os.path.isfile(path):
+        return _load_yaml_docs(path)
+    raise FileNotFoundError(f"resource {entry!r} not found under {base_dir!r}")
+
+
+def build(source: str | Mapping[str, Any], base_dir: str | None = None) -> list[dict]:
+    """``kustomize build``: resolve a kustomization (directory path,
+    kustomization file path, or inline dict) into final manifests."""
+    if isinstance(source, str):
+        if _is_kustomization_dir(source):
+            base_dir = source
+            for n in KUSTOMIZATION_NAMES:
+                p = os.path.join(source, n)
+                if os.path.isfile(p):
+                    kust = yaml.safe_load(open(p).read()) or {}
+                    break
+        elif os.path.isfile(source):
+            base_dir = os.path.dirname(os.path.abspath(source))
+            kust = yaml.safe_load(open(source).read()) or {}
+        else:
+            raise FileNotFoundError(source)
+    else:
+        kust = dict(source)
+        base_dir = base_dir or os.getcwd()
+
+    manifests: list[dict] = []
+    for entry in kust.get("resources", []):
+        if isinstance(entry, Mapping):  # inline resource
+            manifests.append(copy.deepcopy(dict(entry)))
+        else:
+            manifests.extend(_load_resources(entry, base_dir))
+
+    # configMapGenerator: literals → ConfigMap manifests
+    for gen in kust.get("configMapGenerator", []):
+        data = dict(gen.get("literals_map") or {})
+        for lit in gen.get("literals", []):
+            k, _, v = str(lit).partition("=")
+            data[k] = v
+        manifests.append(
+            {
+                "kind": "ConfigMap",
+                "metadata": {"name": gen["name"]},
+                "data": data,
+            }
+        )
+
+    # patchesStrategicMerge: match by kind+name, merge
+    for patch in kust.get("patchesStrategicMerge", []):
+        if isinstance(patch, str):
+            pdocs = _load_yaml_docs(
+                patch if os.path.isabs(patch) else os.path.join(base_dir, patch)
+            )
+        else:
+            pdocs = [patch]
+        for pd in pdocs:
+            target = {
+                "kind": pd.get("kind"),
+                "name": pd.get("metadata", {}).get("name"),
+            }
+            hit = False
+            for i, m in enumerate(manifests):
+                if _matches(target, m):
+                    manifests[i] = strategic_merge(m, pd)
+                    hit = True
+            if not hit:
+                raise ValueError(
+                    f"patchesStrategicMerge target not found: {target}"
+                )
+
+    # targeted patches
+    for p in kust.get("patches", []):
+        patch = p.get("patch")
+        if isinstance(patch, str):
+            patch = yaml.safe_load(patch)
+        target = p.get("target", {})
+        hit = False
+        for i, m in enumerate(manifests):
+            if _matches(target, m):
+                manifests[i] = strategic_merge(m, patch)
+                hit = True
+        if not hit:
+            raise ValueError(f"patch target not found: {target}")
+
+    # name/namespace/label/annotation transformers
+    prefix = kust.get("namePrefix", "")
+    suffix = kust.get("nameSuffix", "")
+    namespace = kust.get("namespace")
+    labels = kust.get("commonLabels", {})
+    annotations = kust.get("commonAnnotations", {})
+    for m in manifests:
+        meta = m.setdefault("metadata", {})
+        if prefix or suffix:
+            meta["name"] = f"{prefix}{meta.get('name', '')}{suffix}"
+        if namespace:
+            meta["namespace"] = namespace
+        if labels:
+            meta["labels"] = {**meta.get("labels", {}), **labels}
+        if annotations:
+            meta["annotations"] = {
+                **meta.get("annotations", {}), **annotations
+            }
+    return manifests
+
+
+# --------------------------------------------------------------------------- #
+# typed dispatch (the `kubectl apply -k` path)
+# --------------------------------------------------------------------------- #
+
+#: kinds → parser returning a typed spec this framework can submit
+def parse(manifest: Mapping[str, Any]) -> Any:
+    kind = manifest.get("kind", "")
+    if kind in ("JAXJob", "PyTorchJob", "TFJob", "MPIJob", "XGBoostJob",
+                "PaddleJob"):
+        from kubeflow_tpu.orchestrator.kinds import from_manifest
+
+        return from_manifest(manifest)
+    if kind == "InferenceService":
+        from kubeflow_tpu.serve.spec import InferenceServiceSpec
+
+        return InferenceServiceSpec.from_manifest(manifest)
+    if kind == "Experiment":
+        from kubeflow_tpu.tune.spec import ExperimentSpec
+
+        return ExperimentSpec.from_dict(
+            {"name": manifest.get("metadata", {}).get("name"),
+             **manifest.get("spec", {})}
+        )
+    if kind == "ConfigMap":
+        return dict(manifest)
+    raise ValueError(f"no parser for manifest kind {kind!r}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m kubeflow_tpu.platform.manifests <dir>`` — the
+    ``kustomize build`` CLI: print resolved manifests as a YAML stream."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(description="kustomize-build analog")
+    p.add_argument("path", help="kustomization directory or file")
+    args = p.parse_args(argv)
+    yaml.safe_dump_all(build(args.path), sys.stdout, sort_keys=False)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -62,6 +62,13 @@ class ServiceState:
         return self.default_model is not None and self.default_model.ready
 
 
+
+def _retire(model) -> None:
+    """Permanently remove a model (service deleted / replaced by rollout).
+    Mesh-backed models distinguish retire (deregister) from unload
+    (release residency, keep registration — the scale-to-zero path)."""
+    getattr(model, "retire", model.unload)()
+
 class InferenceServiceController:
     def __init__(
         self,
@@ -105,7 +112,7 @@ class InferenceServiceController:
         if st:
             for m in (st.default_model, st.canary_model):
                 if m is not None:
-                    m.unload()
+                    _retire(m)
 
     def get(self, name: str, namespace: str = "default") -> ServiceState:
         return self._services[f"{namespace}/{name}"]
@@ -131,10 +138,10 @@ class InferenceServiceController:
                 st.default_model = self._materialise(spec)
                 st.default_key = new_key
                 if old is not None:
-                    old.unload()
+                    _retire(old)
                 st.conditions.append("PredictorReady")
             if st.canary_model is not None:
-                st.canary_model.unload()
+                _retire(st.canary_model)
                 st.canary_model, st.canary_key = None, None
         else:
             # canary rollout: new spec serves pct% alongside the old default
@@ -143,7 +150,7 @@ class InferenceServiceController:
                 st.canary_model = self._materialise(spec)
                 st.canary_key = new_key
                 if old is not None:
-                    old.unload()
+                    _retire(old)
                 st.conditions.append("PredictorReady")
 
         rs = st.replicas
@@ -209,7 +216,7 @@ class InferenceServiceController:
         st.default_key, st.canary_key = st.canary_key, None
         st.spec.predictor.canary_traffic_percent = 100
         if old is not None:
-            old.unload()
+            _retire(old)
 
     def autoscale_tick(self, name: str, namespace: str = "default") -> int:
         """One autoscaler evaluation; returns the new ready replica count."""

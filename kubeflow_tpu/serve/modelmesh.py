@@ -60,6 +60,7 @@ class _Entry:
         self.loads = 0
         self.error: str | None = None
         self.failed_at = 0.0
+        self.pins = 0  # in-flight requests holding the weights resident
 
 
 class ModelMesh:
@@ -104,6 +105,18 @@ class ModelMesh:
             e = self._entries.pop(name, None)
         if e is not None and e.model is not None:
             e.model.unload()
+
+    def release(self, name: str) -> None:
+        """Evict ``name``'s weights but KEEP the registration — the
+        scale-to-zero path: the next request cold-starts it back in. A
+        ``deregister`` here would brick the service instead."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None or e.state != ModelState.LOADED or e.pins > 0:
+                return
+            model, e.model, e.bytes = e.model, None, 0
+            e.state = ModelState.REGISTERED
+        model.unload()
 
     def names(self) -> list[str]:
         with self._lock:
@@ -184,6 +197,11 @@ class ModelMesh:
                     f"model {name!r} failed to load: {ex}"
                 ) from ex
             with self._lock:
+                if self._entries.get(name) is not e:
+                    # deregistered while loading: committing would orphan
+                    # HBM-resident weights outside all budget accounting
+                    model.unload()
+                    raise KeyError(name)
                 if size > self.budget:
                     e.state = ModelState.FAILED
                     e.error = (
@@ -203,15 +221,18 @@ class ModelMesh:
                 return model
 
     def _evict_locked(self, need: int, keep: str) -> None:
-        """Evict least-recently-used residents until ``need`` fits."""
+        """Evict least-recently-used UNPINNED residents until ``need``
+        fits. Pinned entries (in-flight requests) are never evicted —
+        pulling params out from under a running forward is a crash."""
         while self.resident_bytes() + need > self.budget:
             victims = [
                 e for n, e in self._entries.items()
-                if e.state == ModelState.LOADED and n != keep
+                if e.state == ModelState.LOADED and n != keep and e.pins == 0
             ]
             if not victims:
                 raise RuntimeError(
-                    f"cannot fit {need} bytes within budget {self.budget}"
+                    f"cannot fit {need} bytes within budget {self.budget} "
+                    "(remaining residents are pinned by in-flight requests)"
                 )
             victim = min(victims, key=lambda e: e.last_used)
             victim.model.unload()
@@ -219,6 +240,36 @@ class ModelMesh:
             victim.bytes = 0
             victim.state = ModelState.REGISTERED
             self.stats["evictions"] += 1
+
+    def pinned(self, name: str):
+        """Context manager: load + pin ``name`` for the duration of a
+        request, so concurrent loads cannot evict it mid-forward."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            while True:
+                model = self.model(name)
+                with self._lock:
+                    e = self._entries.get(name)
+                    # re-check under the lock: an eviction may have struck
+                    # between model() returning and the pin landing
+                    if (
+                        e is not None
+                        and e.state == ModelState.LOADED
+                        and e.model is model
+                    ):
+                        e.pins += 1
+                        break
+            try:
+                yield model
+            finally:
+                with self._lock:
+                    e = self._entries.get(name)
+                    if e is not None and e.pins > 0:
+                        e.pins -= 1
+
+        return cm()
 
 
 class MeshBackedModel(Model):
@@ -270,16 +321,27 @@ class MeshBackedModel(Model):
         return True
 
     def unload(self) -> None:
+        """Release residency, KEEP the registration — this is what the
+        autoscaler's scale-to-zero calls; the next request cold-starts the
+        weights back in. Permanent removal is ``retire()``."""
+        self._mesh.release(self.key)
+
+    def retire(self) -> None:
+        """Permanently remove from the mesh (service deleted / rolled out)."""
         self._mesh.deregister(self.key)
 
     def preprocess(self, payload: Any, headers=None) -> Any:
-        return self._mesh.model(self.key).preprocess(payload, headers)
+        with self._mesh.pinned(self.key) as m:
+            return m.preprocess(payload, headers)
 
     def predict(self, inputs: Any, headers=None) -> Any:
-        return self._mesh.model(self.key).predict(inputs, headers)
+        with self._mesh.pinned(self.key) as m:
+            return m.predict(inputs, headers)
 
     def postprocess(self, outputs: Any, headers=None) -> Any:
-        return self._mesh.model(self.key).postprocess(outputs, headers)
+        with self._mesh.pinned(self.key) as m:
+            return m.postprocess(outputs, headers)
 
     async def __call__(self, payload: Any, headers=None) -> Any:
-        return await self._mesh.model(self.key)(payload, headers)
+        with self._mesh.pinned(self.key) as m:
+            return await m(payload, headers)

@@ -95,14 +95,13 @@ class SklearnRuntimeModel(Model):
 
         coef = getattr(est, "coef_", None)
         intercept = getattr(est, "intercept_", None)
-        classes = getattr(est, "classes_", None)
-        if coef is not None and intercept is not None:
-            # Guard the fast path to OVR/plain-linear shapes: OVO estimators
-            # (SVC(kernel='linear')) expose one coef_ row per class PAIR and
-            # need pairwise voting, not argmax — those serve on host.
-            rows = np.atleast_2d(np.asarray(coef)).shape[0]
-            if classes is not None and rows not in (1, len(classes)):
-                coef = None
+        # Gate the fast path to sklearn.linear_model estimators: their
+        # decision functions are OVR/plain-linear, so argmax of X@W+b IS
+        # their predict. SVC-family estimators expose coef_ too but with one
+        # row per class PAIR (OVO voting — shape-indistinguishable at n=3),
+        # so anything outside linear_model serves on host. Correct > fast.
+        if not type(est).__module__.startswith("sklearn.linear_model"):
+            coef = None
         if coef is not None and intercept is not None:
             import jax
             import jax.numpy as jnp

@@ -74,6 +74,27 @@ class TrialDB:
             )
             self._db.commit()
 
+    def experiments(self) -> list[dict]:
+        """Experiment rollups for the tuner UI (Katib-UI analog)."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT experiment, COUNT(*),"
+                " SUM(state='Succeeded'), SUM(state='Failed'),"
+                " SUM(state='Running'), MAX(updated)"
+                " FROM trials GROUP BY experiment ORDER BY MAX(updated) DESC"
+            ).fetchall()
+        return [
+            {
+                "name": name,
+                "trials": total,
+                "succeeded": ok or 0,
+                "failed": failed or 0,
+                "running": running or 0,
+                "updated": updated,
+            }
+            for name, total, ok, failed, running, updated in rows
+        ]
+
     def load_trials(self, experiment: str) -> list[Trial]:
         with self._lock:
             rows = self._db.execute(

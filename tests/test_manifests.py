@@ -1,0 +1,250 @@
+"""Manifest overlay/layering plane (SURVEY.md §2.5 "Manifests" row, §1 L8):
+kustomize-equivalent base+overlay builds over this framework's manifests."""
+
+import textwrap
+
+import pytest
+import yaml
+
+from kubeflow_tpu.platform import manifests as km
+
+
+def _write(path, text):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(text))
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    """base (JAXJob + ISVC) + dev overlay + prod overlay-of-overlay."""
+    _write(tmp_path / "base" / "job.yaml", """
+        kind: JAXJob
+        metadata:
+          name: train
+        spec:
+          jaxReplicaSpecs:
+            Worker:
+              replicas: 2
+              template:
+                spec:
+                  containers:
+                    - name: jax
+                      command: ["python", "-m", "kubeflow_tpu.examples.mnist"]
+    """)
+    _write(tmp_path / "base" / "isvc.yaml", """
+        kind: InferenceService
+        metadata:
+          name: bert
+        spec:
+          predictor:
+            model:
+              modelFormat:
+                name: bert-tiny
+    """)
+    _write(tmp_path / "base" / "kustomization.yaml", """
+        resources:
+          - job.yaml
+          - isvc.yaml
+    """)
+    _write(tmp_path / "dev" / "kustomization.yaml", """
+        resources:
+          - ../base
+        namePrefix: dev-
+        commonLabels:
+          env: dev
+        patchesStrategicMerge:
+          - patch_job.yaml
+    """)
+    _write(tmp_path / "dev" / "patch_job.yaml", """
+        kind: JAXJob
+        metadata:
+          name: train
+        spec:
+          jaxReplicaSpecs:
+            Worker:
+              replicas: 4
+    """)
+    _write(tmp_path / "prod" / "kustomization.yaml", """
+        resources:
+          - ../dev
+        namespace: prod
+        nameSuffix: -v2
+        patches:
+          - target:
+              kind: JAXJob
+            patch: |
+              spec:
+                runPolicy:
+                  backoffLimit: 3
+        configMapGenerator:
+          - name: train-config
+            literals:
+              - LR=0.001
+              - STEPS=100
+    """)
+    return tmp_path
+
+
+def test_base_build(tree):
+    objs = km.build(str(tree / "base"))
+    assert [m["kind"] for m in objs] == ["JAXJob", "InferenceService"]
+    assert objs[0]["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] == 2
+
+
+def test_overlay_patches_and_transformers(tree):
+    objs = km.build(str(tree / "dev"))
+    job = next(m for m in objs if m["kind"] == "JAXJob")
+    # strategic merge changed replicas but kept the container command
+    assert job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] == 4
+    containers = job["spec"]["jaxReplicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"
+    ]
+    assert containers[0]["command"][0] == "python"
+    # transformers applied to every resource
+    for m in objs:
+        assert m["metadata"]["name"].startswith("dev-")
+        assert m["metadata"]["labels"]["env"] == "dev"
+
+
+def test_overlay_of_overlay(tree):
+    objs = km.build(str(tree / "prod"))
+    job = next(m for m in objs if m["kind"] == "JAXJob")
+    assert job["metadata"]["name"] == "dev-train-v2"
+    assert job["metadata"]["namespace"] == "prod"
+    assert job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] == 4
+    assert job["spec"]["runPolicy"]["backoffLimit"] == 3
+    cm = next(m for m in objs if m["kind"] == "ConfigMap")
+    assert cm["data"] == {"LR": "0.001", "STEPS": "100"}
+    # generators belong to the level that declares them: prod's transformers
+    # apply (suffix), dev's do not (no prefix) — kustomize semantics
+    assert cm["metadata"]["name"] == "train-config-v2"
+
+
+def test_strategic_merge_semantics():
+    base = {
+        "containers": [
+            {"name": "a", "image": "x", "env": [{"name": "K", "value": "1"}]},
+            {"name": "b", "image": "y"},
+        ],
+        "drop_me": 1,
+        "keep": {"deep": True},
+    }
+    patch = {
+        "containers": [{"name": "a", "image": "x2"}],
+        "drop_me": None,
+        "keep": {"extra": 2},
+    }
+    out = km.strategic_merge(base, patch)
+    by_name = {c["name"]: c for c in out["containers"]}
+    assert by_name["a"]["image"] == "x2"
+    assert by_name["a"]["env"] == [{"name": "K", "value": "1"}]  # merged, kept
+    assert by_name["b"]["image"] == "y"  # untouched sibling survives
+    assert "drop_me" not in out  # null deletes
+    assert out["keep"] == {"deep": True, "extra": 2}
+
+
+def test_unmatched_patch_is_an_error(tree):
+    with pytest.raises(ValueError, match="target not found"):
+        km.build(
+            {
+                "resources": [str(tree / "base")],
+                "patchesStrategicMerge": [
+                    {"kind": "JAXJob", "metadata": {"name": "ghost"}}
+                ],
+            }
+        )
+
+
+def test_build_then_parse_then_submit(tree, tmp_path):
+    """The `kubectl apply -k` path: built manifests parse to typed specs
+    and a JAXJob actually runs through the cluster."""
+    import sys
+
+    from kubeflow_tpu.orchestrator.cluster import LocalCluster
+    from kubeflow_tpu.orchestrator.spec import JobSpec
+
+    objs = km.build(str(tree / "dev"))
+    specs = [km.parse(m) for m in objs]
+    job = next(s for s in specs if isinstance(s, JobSpec))
+    assert job.name == "dev-train"
+    assert job.replicas["worker"].replicas == 4
+
+    # shrink to something that actually finishes, then run it
+    fast = km.build(
+        {
+            "resources": [str(tree / "base")],
+            "patchesStrategicMerge": [
+                {
+                    "kind": "JAXJob",
+                    "metadata": {"name": "train"},
+                    "spec": {
+                        "jaxReplicaSpecs": {
+                            "Worker": {
+                                "replicas": 1,
+                                "template": {
+                                    "spec": {
+                                        "containers": [
+                                            {
+                                                "name": "jax",
+                                                "command": [
+                                                    sys.executable,
+                                                    "-c",
+                                                    "print('ok')",
+                                                ],
+                                            }
+                                        ]
+                                    }
+                                },
+                            }
+                        }
+                    },
+                }
+            ],
+        }
+    )
+    spec = km.parse(next(m for m in fast if m["kind"] == "JAXJob"))
+    with LocalCluster(base_dir=str(tmp_path / "c")) as cluster:
+        uid = cluster.submit(spec)
+        status = cluster.wait(uid, timeout=60)
+    assert status.phase == "Succeeded"
+
+
+def test_experiment_manifest_parses():
+    exp = km.parse(
+        {
+            "kind": "Experiment",
+            "metadata": {"name": "sweep"},
+            "spec": yaml.safe_load(
+                """
+                parameters:
+                  - name: lr
+                    type: double
+                    min: 0.0001
+                    max: 0.1
+                objective:
+                  metric: loss
+                  type: minimize
+                algorithm:
+                  name: random
+                max_trial_count: 4
+                parallel_trial_count: 2
+                """
+            ),
+        }
+    )
+    assert exp.name == "sweep" and exp.parameters[0].name == "lr"
+
+
+def test_example_overlay_tree_builds_and_parses():
+    """The shipped examples/manifests tree is a working overlay stack."""
+    import pathlib
+
+    import kubeflow_tpu
+
+    root = pathlib.Path(kubeflow_tpu.__file__).parent / "examples" / "manifests"
+    objs = km.build(str(root / "overlays" / "dev"))
+    kinds = sorted(m["kind"] for m in objs)
+    assert kinds == ["InferenceService", "JAXJob"]
+    for m in objs:
+        assert m["metadata"]["name"].startswith("dev-")
+        km.parse(m)  # typed parse must succeed for every shipped manifest

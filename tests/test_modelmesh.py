@@ -266,3 +266,42 @@ def test_concurrent_loads_serialize_within_budget():
     assert not errs
     assert mesh.resident_bytes() <= mesh.budget
     assert mesh.stats["loads"] == 2  # one load per model, no double-loads
+
+
+def test_scale_to_zero_then_cold_start_recovers():
+    """Scale-to-zero releases HBM but keeps the registration: the next
+    request cold-starts the weights back in (unload vs retire split)."""
+    mesh = ModelMesh(4 * PER_MODEL)
+    loads = {"n": 0}
+
+    def factory():
+        loads["n"] += 1
+        return _jax_model("svc")
+
+    proxy = MeshBackedModel(mesh, "svc", factory)
+    proxy.load()
+    assert mesh.resident() == ["svc"]
+    proxy.unload()  # the autoscaler's scale-to-zero call
+    assert mesh.resident() == [] and "svc" in mesh.names()
+    out = proxy.predict(proxy.preprocess({"instances": [[1, 2]]}))
+    assert out.shape[0] == 1 and loads["n"] == 2  # cold-started back in
+    proxy.retire()  # service deletion
+    assert "svc" not in mesh.names()
+
+
+def test_pinned_entry_never_evicted_mid_request():
+    """An in-flight request pins its model; a concurrent load must evict
+    someone else or fail — never the pinned weights."""
+    mesh = ModelMesh(2 * PER_MODEL + 64)
+    for n in ("a", "b", "c"):
+        mesh.register(n, lambda n=n: _jax_model(n))
+    mesh.model("a")
+    mesh.model("b")
+    with mesh.pinned("a") as am:
+        mesh.model("c")  # must evict b (LRU among unpinned), not pinned a
+        assert "a" in mesh.resident()
+        assert am._params is not None  # still usable mid-"request"
+        # with a and c pinned... load b: only unpinned victim is c? a pinned,
+        # c unpinned -> evicts c
+        mesh.model("b")
+        assert "a" in mesh.resident()

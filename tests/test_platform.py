@@ -313,3 +313,146 @@ def test_dashboard_aggregates_all_planes(cluster, tmp_path):
             urllib.request.urlopen(dash.url + "/api/notebooks").read()
         )
         assert nbs[0]["name"] == "ws"
+
+
+def test_dashboard_ui_and_crud(cluster, tmp_path):
+    """§2.5 CRUD web-app analog: the dashboard serves an HTML UI and
+    writable endpoints — submit/delete jobs, notebooks, tensorboards over
+    HTTP, read logs back."""
+    import urllib.error
+
+    nb = NotebookController(cluster)
+    tb = TensorboardController(cluster)
+    with DashboardServer(cluster, notebooks=nb, tensorboards=tb) as dash:
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                dash.url + path,
+                method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+                headers={"content-type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                raw = r.read().decode()
+                return json.loads(raw) if raw.startswith(("{", "[")) else raw
+
+        # HTML SPA served at /
+        html = call("GET", "/")
+        assert "<!doctype html>" in html and "/api/summary" in html
+
+        # job CRUD through a CRD manifest
+        out = call("POST", "/api/jobs", {
+            "kind": "JAXJob",
+            "metadata": {"name": "ui-job"},
+            "spec": {"jaxReplicaSpecs": {"Worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": [{
+                    "name": "jax",
+                    "command": [PY, "-c", "print('from-the-ui')"],
+                }]}},
+            }}},
+        })
+        uid = out["uid"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if cluster.status(uid).phase == "Succeeded":
+                break
+            time.sleep(0.05)
+        assert cluster.status(uid).phase == "Succeeded"
+        assert "from-the-ui" in call("GET", f"/api/jobs/{uid}/logs")
+        call("DELETE", f"/api/jobs/{uid}")
+
+        # notebook CRUD
+        call("POST", "/api/notebooks", {"name": "ui-nb"})
+        assert any(
+            n["name"] == "ui-nb" for n in call("GET", "/api/notebooks")
+        )
+        call("DELETE", "/api/notebooks/ui-nb")
+
+        # tensorboard CRUD
+        call("POST", "/api/tensorboards",
+             {"name": "ui-tb", "logdir": str(tmp_path)})
+        assert any(
+            t["name"] == "ui-tb" for t in call("GET", "/api/tensorboards")
+        )
+        call("DELETE", "/api/tensorboards/ui-tb")
+
+        # bad manifest is a 400, unknown uid a 404 — not a 500
+        for method, path, body, code in (
+            ("POST", "/api/jobs", {"kind": "Nope"}, 400),
+            ("DELETE", "/api/jobs/ghost", None, 404),
+        ):
+            try:
+                call(method, path, body)
+                raise AssertionError("expected HTTPError")
+            except urllib.error.HTTPError as e:
+                assert e.code == code
+
+
+def test_dashboard_experiments_and_pipelines_tabs(cluster, tmp_path):
+    """Katib-UI / KFP-frontend analogs: experiment and pipeline-run views
+    backed by the persistent tune DB and lineage store."""
+    from kubeflow_tpu.pipelines.metadata import LineageStore
+    from kubeflow_tpu.tune.db import TrialDB
+    from kubeflow_tpu.tune.spec import Trial, TrialAssignment, TrialState
+
+    db = TrialDB(str(tmp_path / "t.db"))
+    for i, state in enumerate(
+        (TrialState.SUCCEEDED, TrialState.SUCCEEDED, TrialState.FAILED)
+    ):
+        t = Trial(assignment=TrialAssignment({"lr": 0.1 * (i + 1)},
+                                             trial_id=f"t{i}"))
+        t.state = state
+        t.metrics = {"loss": float(i)}
+        db.record_trial("sweep", t)
+
+    store = LineageStore(str(tmp_path / "l.db"))
+    e1 = store.begin_execution("run-1", "prep", "prep-comp")
+    store.finish_execution(e1, state="Succeeded")
+    e2 = store.begin_execution("run-1", "train", "train-comp")
+    store.finish_execution(e2, state="Succeeded")
+
+    with DashboardServer(cluster, tune_db=db, lineage=store) as dash:
+        exps = json.loads(
+            urllib.request.urlopen(dash.url + "/api/experiments").read()
+        )
+        assert exps == [{"name": "sweep", "trials": 3, "succeeded": 2,
+                         "failed": 1, "running": 0,
+                         "updated": exps[0]["updated"]}]
+        trials = json.loads(
+            urllib.request.urlopen(
+                dash.url + "/api/experiments/sweep/trials"
+            ).read()
+        )
+        assert len(trials) == 3 and trials[0]["parameters"]["lr"] == 0.1
+        runs = json.loads(
+            urllib.request.urlopen(dash.url + "/api/pipelines").read()
+        )
+        assert runs[0]["run_id"] == "run-1"
+        assert runs[0]["state"] == "Succeeded" and runs[0]["tasks"] == 2
+        tasks = json.loads(
+            urllib.request.urlopen(
+                dash.url + "/api/pipelines/run-1/tasks"
+            ).read()
+        )
+        assert [t["task"] for t in tasks] == ["prep", "train"]
+        summary = json.loads(
+            urllib.request.urlopen(dash.url + "/api/summary").read()
+        )
+        assert summary["experiments"] == 1
+        assert summary["pipeline_runs"] == 1
+
+
+def test_dashboard_rejects_hostile_names(cluster):
+    nb = NotebookController(cluster)
+    with DashboardServer(cluster, notebooks=nb) as dash:
+        req = urllib.request.Request(
+            dash.url + "/api/notebooks",
+            method="POST",
+            data=json.dumps({"name": "<img src=x onerror=alert(1)>"}).encode(),
+            headers={"content-type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400

@@ -897,3 +897,25 @@ def test_storage_verified_cache_skips_refetch(tmp_path):
     finally:
         storage_mod._FETCHERS.pop("count", None)
     assert a == b and calls["n"] == 1  # second call was a verified cache hit
+
+
+def test_sklearn_ovo_svc_stays_on_host_and_correct(tmp_path, devices8):
+    """SVC(kernel='linear') exposes pairwise coef_ (OVO); it must NOT take
+    the argmax device path — predictions must equal sklearn's voting."""
+    import joblib
+    from sklearn.svm import SVC
+
+    from kubeflow_tpu.serve.sklearn_runtime import SklearnRuntimeModel
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(120, 4)
+    y = rng.randint(0, 3, 120)  # 3 classes: n(n-1)/2 == n edge case
+    svc = SVC(kernel="linear").fit(X, y)
+    joblib.dump(svc, tmp_path / "model.joblib")
+    m = SklearnRuntimeModel("svc", str(tmp_path))
+    m.load()
+    assert m._jitted is None, "OVO estimator must not take the argmax path"
+    Xq = rng.randn(16, 4)
+    np.testing.assert_array_equal(
+        m.predict(m.preprocess({"instances": Xq.tolist()})), svc.predict(Xq)
+    )
