@@ -290,7 +290,28 @@ class DashboardServer(ThreadedAiohttpServer):
         async def index(request):
             return web.Response(text=_INDEX_HTML, content_type="text/html")
 
-        app = web.Application()
+        @web.middleware
+        async def csrf_guard(request, handler):
+            # State-changing endpoints submit jobs that EXECUTE COMMANDS, so
+            # a hostile web page must not be able to drive them cross-site:
+            # (a) require a JSON content type — text/plain form posts and
+            # other no-preflight vehicles are rejected; (b) pin the Host
+            # header to the bound address — blocks DNS-rebinding around the
+            # loopback bind. Same-origin fetch() from the SPA passes both.
+            if request.method in ("POST", "PUT", "DELETE"):
+                ctype = request.headers.get("content-type", "")
+                if request.method != "DELETE" and not ctype.startswith(
+                    "application/json"
+                ):
+                    raise web.HTTPUnsupportedMediaType(
+                        reason="state-changing requests must be application/json"
+                    )
+                host = request.headers.get("host", "").split(":")[0]
+                if host not in (self.host, "localhost", "127.0.0.1", "[::1]"):
+                    raise web.HTTPForbidden(reason=f"bad host {host!r}")
+            return await handler(request)
+
+        app = web.Application(middlewares=[csrf_guard])
         app.router.add_get("/", index)
         app.router.add_get("/api/summary", handler(self.summary_view))
         app.router.add_get("/api/jobs", handler(self.jobs_view))
@@ -370,7 +391,10 @@ function pill(p){return raw(`<span class="pill ${esc(p)}">${esc(p)}</span>`)}
 // browser; only values wrapped in raw() render as HTML
 function raw(h){return {__html:h}}
 function cell(v){return v&&v.__html!==undefined?v.__html:esc(v??"")}
-function uenc(s){return esc(encodeURIComponent(s))}
+// strict percent-encoding: encodeURIComponent leaves !'()* alone, and a
+// bare ' would break out of single-quoted onclick JS (stored XSS)
+function uenc(s){return esc(encodeURIComponent(s).replace(/[!'()*]/g,
+ c=>"%"+c.charCodeAt(0).toString(16)))}
 function table(rows,cols,actions){if(!rows.length)return "<p>none</p>";
  return `<table><tr>${cols.map(c=>`<th>${esc(c)}</th>`).join("")}${actions?"<th></th>":""}</tr>`+
  rows.map(r=>`<tr>${cols.map(c=>`<td>${cell(r[c])}</td>`).join("")}${actions?`<td>${actions(r)}</td>`:""}</tr>`).join("")+"</table>"}

@@ -23,7 +23,7 @@ import random
 import time
 from typing import Any
 
-from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.serve.model import Model, retire as _retire
 from kubeflow_tpu.serve.spec import (
     InferenceServiceSpec,
     RuntimeRegistry,
@@ -42,9 +42,24 @@ class ReplicaSet:
     cold_starts: int = 0
 
 
-def _mat_key(p) -> tuple:
-    """What determines the materialised model; a change ⇒ reload."""
-    return (p.model_format, p.storage_uri, p.runtime, dict(p.extra))
+def _component_key(c) -> tuple | None:
+    if c is None:
+        return None
+    return (c.model_format, c.storage_uri, c.runtime, dict(c.extra))
+
+
+def _mat_key(spec_or_predictor) -> tuple:
+    """What determines the materialised model; a change ⇒ reload. Accepts
+    the full ISVC spec (predictor + transformer + explainer all count) or a
+    bare component for callers keying one component."""
+    s = spec_or_predictor
+    if hasattr(s, "predictor"):
+        return (
+            _component_key(s.predictor),
+            _component_key(s.transformer),
+            _component_key(s.explainer),
+        )
+    return _component_key(s)
 
 
 @dataclasses.dataclass
@@ -61,13 +76,6 @@ class ServiceState:
     def ready(self) -> bool:
         return self.default_model is not None and self.default_model.ready
 
-
-
-def _retire(model) -> None:
-    """Permanently remove a model (service deleted / replaced by rollout).
-    Mesh-backed models distinguish retire (deregister) from unload
-    (release residency, keep registration — the scale-to-zero path)."""
-    getattr(model, "retire", model.unload)()
 
 class InferenceServiceController:
     def __init__(
@@ -125,7 +133,7 @@ class InferenceServiceController:
         p = spec.predictor
         canary_pct = p.canary_traffic_percent
 
-        new_key = _mat_key(p)
+        new_key = _mat_key(spec)
         if st.default_model is None:
             # first deploy: the new spec IS the default, whatever the pct
             st.default_model = self._materialise(spec)
@@ -160,31 +168,62 @@ class InferenceServiceController:
         st.conditions.append("Ready")
 
     def _materialise(self, spec: InferenceServiceSpec) -> Model:
-        p = spec.predictor
-        rt = self.registry.resolve(p)
+        predictor = self._materialise_component(
+            spec, spec.predictor, spec.name
+        )
+        if spec.transformer is None and spec.explainer is None:
+            return predictor
+        # transformer/explainer components compose IN-PROCESS around the
+        # predictor (serve/composite.py) — no per-component pod hop on TPU
+        from kubeflow_tpu.serve.composite import ComposedService
+
+        transformer = (
+            self._materialise_component(
+                spec, spec.transformer, f"{spec.name}-transformer"
+            )
+            if spec.transformer is not None
+            else None
+        )
+        explainer = (
+            self._materialise_component(
+                spec, spec.explainer, f"{spec.name}-explainer"
+            )
+            if spec.explainer is not None
+            else None
+        )
+        return ComposedService(
+            spec.name, predictor, transformer=transformer, explainer=explainer
+        )
+
+    def _materialise_component(self, spec, comp, name: str) -> Model:
+        import hashlib
+
+        rt = self.registry.resolve(comp)
+        spec_hash = hashlib.sha256(
+            repr(_component_key(comp)).encode()
+        ).hexdigest()[:12]
         local_path = None
-        if p.storage_uri is not None:
+        if comp.storage_uri is not None:
+            # download dir keyed by spec-hash: identical components (e.g. a
+            # predictor and explainer sharing one checkpoint) download once
             local_path = storage_mod.download(
-                p.storage_uri, f"{self.model_dir}/{spec.name}"
+                comp.storage_uri, f"{self.model_dir}/{spec_hash}"
             )
         if self.model_mesh is not None:
-            import hashlib
-
             from kubeflow_tpu.serve.modelmesh import MeshBackedModel
 
-            # key by (service, spec-hash): a rollout materialises a NEW mesh
-            # entry, so the outgoing model's unload() cannot take the new
-            # one's registration down with it
-            spec_hash = hashlib.sha256(
-                repr(_mat_key(p)).encode()
-            ).hexdigest()[:12]
+            # mesh key = (service, spec-hash): identical components WITHIN a
+            # service (predictor + explainer on one checkpoint) share one
+            # HBM-resident copy, and ModelMesh registrations are refcounted
+            # so a rollout's retire of the old materialisation never takes
+            # down a new one sharing the same component
             return MeshBackedModel(
                 self.model_mesh,
-                spec.name,
-                lambda: rt.factory(spec.name, local_path, **dict(p.extra)),
+                name,
+                lambda: rt.factory(name, local_path, **dict(comp.extra)),
                 key=f"{spec.namespace}/{spec.name}@{spec_hash}",
             )
-        model = rt.factory(spec.name, local_path, **dict(p.extra))
+        model = rt.factory(name, local_path, **dict(comp.extra))
         if not model.ready:
             model.load()
         return model

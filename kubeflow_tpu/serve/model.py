@@ -28,6 +28,14 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def retire(model: "Model") -> None:
+    """Permanently remove a model (service deleted / replaced by rollout).
+    Mesh-backed models distinguish retire (deregister) from unload
+    (release residency, keep registration — the scale-to-zero path);
+    plain models just unload."""
+    getattr(model, "retire", model.unload)()
+
+
 class Model:
     """Base serving model: subclass and override the lifecycle hooks.
 
@@ -54,6 +62,11 @@ class Model:
 
     def unload(self) -> None:
         self.ready = False
+
+    def explain(self, payload: Any, headers: Mapping[str, str] | None = None) -> Any:
+        """The ``:explain`` verb (KServe explainer component). Runtimes with
+        a meaningful attribution story override this; the default is 501."""
+        raise NotImplementedError(f"model '{self.name}' has no explainer")
 
     async def __call__(self, payload: Any, headers: Mapping[str, str] | None = None) -> Any:
         x = self.preprocess(payload, headers)

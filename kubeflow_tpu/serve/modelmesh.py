@@ -61,6 +61,9 @@ class _Entry:
         self.error: str | None = None
         self.failed_at = 0.0
         self.pins = 0  # in-flight requests holding the weights resident
+        self.refs = 1  # registrations sharing this entry (rollouts, shared
+        #              # components) — deregister removes only at zero
+        self.draining = False  # deregistered while pinned: unload at unpin
 
 
 class ModelMesh:
@@ -95,16 +98,33 @@ class ModelMesh:
 
     def register(self, name: str, factory: Callable[[], Model]) -> None:
         """Make a model servable WITHOUT loading it (density is the point:
-        registration is O(1) metadata, HBM is spent only on demand)."""
+        registration is O(1) metadata, HBM is spent only on demand).
+        Registrations are REFCOUNTED: a rollout whose new materialisation
+        shares the old one's key must survive the old one's deregister."""
         with self._lock:
-            if name not in self._entries:
+            e = self._entries.get(name)
+            if e is None:
                 self._entries[name] = _Entry(name, factory)
+            else:
+                e.refs += 1
 
     def deregister(self, name: str) -> None:
         with self._lock:
-            e = self._entries.pop(name, None)
-        if e is not None and e.model is not None:
-            e.model.unload()
+            e = self._entries.get(name)
+            if e is None:
+                return
+            e.refs -= 1
+            if e.refs > 0:
+                return
+            self._entries.pop(name)
+            if e.pins > 0:
+                # an in-flight request holds the weights: unloading now
+                # would free params mid-forward — the last unpin drains it
+                e.draining = True
+                return
+            model, e.model = e.model, None
+        if model is not None:
+            model.unload()
 
     def release(self, name: str) -> None:
         """Evict ``name``'s weights but KEEP the registration — the
@@ -264,10 +284,16 @@ class ModelMesh:
             try:
                 yield model
             finally:
+                # unpin the CAPTURED entry, never a same-name successor — a
+                # deregister+re-register cycle must not steal another
+                # request's pin
+                drain = None
                 with self._lock:
-                    e = self._entries.get(name)
-                    if e is not None and e.pins > 0:
-                        e.pins -= 1
+                    e.pins -= 1
+                    if e.draining and e.pins == 0:
+                        drain, e.model = e.model, None
+                if drain is not None:
+                    drain.unload()
 
         return cm()
 

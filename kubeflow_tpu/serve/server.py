@@ -121,6 +121,15 @@ class DataPlane:
             self.logger.log_response(name, req_id, result)
         return result
 
+    async def explain(self, name: str, payload: Any, headers=None) -> Any:
+        model = self.get(name)
+        if not model.ready:
+            raise web.HTTPServiceUnavailable(reason=f"model '{name}' not ready")
+        out = model.explain(payload, headers)
+        if isinstance(out, dict) and "explanations" in out:
+            return out
+        return {"explanations": out}
+
 
 class ModelServer:
     def __init__(
@@ -158,6 +167,7 @@ class ModelServer:
         )
         app.router.add_get("/v1/models/{name}", self._v1_status)
         app.router.add_post("/v1/models/{name}:predict", self._v1_predict)
+        app.router.add_post("/v1/models/{name}:explain", self._v1_explain)
         app.router.add_get(
             "/v2/health/live", lambda r: web.json_response({"live": True})
         )
@@ -182,6 +192,21 @@ class ModelServer:
         except ValueError as e:
             raise web.HTTPBadRequest(reason=str(e))
         return web.json_response(protocol.encode_v1(result))
+
+    async def _v1_explain(self, req: web.Request) -> web.Response:
+        name = req.match_info["name"]
+        try:
+            body = await req.json()
+            protocol.decode_v1(body)
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        try:
+            result = await self.dataplane.explain(name, body, dict(req.headers))
+        except NotImplementedError as e:
+            raise web.HTTPNotImplemented(reason=str(e))
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        return web.json_response(result)
 
     async def _v2_ready(self, req: web.Request) -> web.Response:
         ready = all(self.dataplane.get(n).ready for n in self.dataplane.list_models())

@@ -157,3 +157,37 @@ class SklearnRuntimeModel(Model):
 
     def postprocess(self, outputs: np.ndarray, headers=None) -> Any:
         return {"predictions": outputs.tolist()}
+
+    def explain(self, payload: Any, headers=None) -> Any:
+        """Exact attributions for linear-family estimators: feature i of
+        row x contributes ``x_i * w_i`` to the decision (plus intercept) —
+        no approximation needed, unlike tree/deep explainers."""
+        est = self._estimator
+        coef = getattr(est, "coef_", None)
+        intercept = getattr(est, "intercept_", None)
+        # same gate as the predict fast path: OVO estimators (linear SVC)
+        # expose pairwise coef_ rows — presenting those as per-class
+        # attributions would be silently wrong
+        if (
+            coef is None
+            or intercept is None
+            or not type(est).__module__.startswith("sklearn.linear_model")
+        ):
+            raise NotImplementedError(
+                f"model '{self.name}': exact attributions need a "
+                "sklearn.linear_model estimator (coef_/intercept_, OVR)"
+            )
+        x = self.preprocess(payload, headers)
+        w = np.atleast_2d(np.asarray(coef))  # (n_out, n_feat)
+        contrib = x[:, None, :] * w[None, :, :]  # (batch, n_out, n_feat)
+        return {
+            "explanations": [
+                {
+                    "contributions": c.squeeze(0).tolist()
+                    if c.shape[0] == 1
+                    else c.tolist(),
+                    "intercept": np.ravel(np.asarray(intercept)).tolist(),
+                }
+                for c in contrib
+            ]
+        }

@@ -305,3 +305,60 @@ def test_pinned_entry_never_evicted_mid_request():
         # c unpinned -> evicts c
         mesh.model("b")
         assert "a" in mesh.resident()
+
+
+def test_rollout_with_shared_component_keeps_new_service_alive():
+    """Refcounted registrations: updating only ONE component of a composed
+    service must not let the old materialisation's retire take down the
+    shared (unchanged) predictor entry."""
+    from kubeflow_tpu.serve.controller import InferenceServiceController
+    from kubeflow_tpu.serve.spec import (
+        ComponentSpec,
+        InferenceServiceSpec,
+        PredictorSpec,
+        RuntimeRegistry,
+        ServingRuntime,
+    )
+
+    reg = RuntimeRegistry()
+    reg.register(
+        ServingRuntime(
+            name="toy", supported_formats=("toy",),
+            factory=lambda name, path, **kw: _jax_model(name), priority=1,
+        )
+    )
+    mesh = ModelMesh(8 * PER_MODEL)
+    ctl = InferenceServiceController(reg, model_mesh=mesh)
+
+    def spec(tflavor):
+        return InferenceServiceSpec(
+            name="svc",
+            predictor=PredictorSpec(model_format="toy"),
+            transformer=ComponentSpec(
+                model_format="toy", extra={"flavor": tflavor}
+            ),
+        )
+
+    ctl.apply(spec("t1"))
+    m1 = ctl.route("svc")
+    m1.predict(m1.preprocess({"instances": [[1]]}))
+    ctl.apply(spec("t2"))  # transformer changes; predictor spec identical
+    m2 = ctl.route("svc")
+    # the old service retired; the shared predictor entry must survive
+    out = m2.predict(m2.preprocess({"instances": [[1]]}))
+    assert out.shape[0] == 1
+    assert m2.ready
+
+
+def test_deregister_while_pinned_drains_at_unpin():
+    mesh = ModelMesh(4 * PER_MODEL)
+    mesh.register("m", lambda: _jax_model("m"))
+    with mesh.pinned("m") as model:
+        mesh.deregister("m")
+        assert "m" not in mesh.names()
+        # weights still live for the in-flight request
+        assert model._params is not None
+        out = model.predict([np.asarray([1, 2], np.int32)])
+        assert out.shape[0] == 1
+    # after unpin the drained weights are gone
+    assert model._params is None

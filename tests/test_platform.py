@@ -456,3 +456,29 @@ def test_dashboard_rejects_hostile_names(cluster):
             raise AssertionError("expected 400")
         except urllib.error.HTTPError as e:
             assert e.code == 400
+
+
+def test_dashboard_csrf_guard(cluster):
+    """Cross-site no-preflight vehicles are rejected: non-JSON POST -> 415,
+    non-local Host -> 403."""
+    with DashboardServer(cluster) as dash:
+        req = urllib.request.Request(
+            dash.url + "/api/jobs", method="POST",
+            data=b"kind=JAXJob", headers={"content-type": "text/plain"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 415")
+        except urllib.error.HTTPError as e:
+            assert e.code == 415
+        req = urllib.request.Request(
+            dash.url + "/api/jobs", method="POST",
+            data=json.dumps({"kind": "JAXJob"}).encode(),
+            headers={"content-type": "application/json",
+                     "Host": "evil.example.com"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403

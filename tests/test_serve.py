@@ -919,3 +919,103 @@ def test_sklearn_ovo_svc_stays_on_host_and_correct(tmp_path, devices8):
     np.testing.assert_array_equal(
         m.predict(m.preprocess({"instances": Xq.tolist()})), svc.predict(Xq)
     )
+
+
+# ---------------------------------------- transformer/explainer components
+
+
+def test_transformer_component_brackets_predictor():
+    """KServe transformer semantics: its pre/postprocess bracket the
+    predictor's full lifecycle — in-process on TPU (serve/composite.py)."""
+    from kubeflow_tpu.serve.composite import ComposedService
+
+    class Upper(Model):  # the "tokenizer service" analog
+        def preprocess(self, payload, headers=None):
+            return {"instances": [s.upper() for s in payload["instances"]]}
+
+        def postprocess(self, outputs, headers=None):
+            return {"predictions": [f"<{p}>" for p in outputs["predictions"]]}
+
+    class Echo(Model):
+        def predict(self, inputs, headers=None):
+            return {"predictions": list(inputs["instances"])}
+
+    svc = ComposedService("svc", Echo("p"), transformer=Upper("t"))
+    out = asyncio.run(svc({"instances": ["a", "b"]}))
+    assert out == {"predictions": ["<A>", "<B>"]}
+
+
+def test_explainer_component_and_v1_explain_endpoint(tmp_path, devices8):
+    """:explain routes to the explainer; sklearn linear attributions are
+    exact: contributions + intercept reconstruct the decision function."""
+    import joblib
+    from sklearn.linear_model import LogisticRegression
+
+    from kubeflow_tpu.serve.controller import InferenceServiceController
+    from kubeflow_tpu.serve.runtimes import default_registry
+    from kubeflow_tpu.serve.spec import ComponentSpec
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(80, 3)
+    y = (X @ [2.0, -1.0, 0.5] > 0).astype(int)
+    clf = LogisticRegression().fit(X, y)
+    src = tmp_path / "m"
+    src.mkdir()
+    joblib.dump(clf, src / "model.joblib")
+
+    ctl = InferenceServiceController(
+        default_registry(), model_dir=str(tmp_path / "dl")
+    )
+    st = ctl.apply(
+        InferenceServiceSpec(
+            name="sk",
+            predictor=PredictorSpec(
+                model_format="sklearn", storage_uri=f"file://{src}"
+            ),
+            explainer=ComponentSpec(
+                model_format="sklearn", storage_uri=f"file://{src}"
+            ),
+        )
+    )
+    assert st.ready
+    server = ModelServer([ctl.route("sk")])
+
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async with TestClient(TestServer(server.build_app())) as client:
+            body = {"instances": [[1.0, 2.0, 3.0]]}
+            r = await client.post("/v1/models/sk:explain", json=body)
+            assert r.status == 200, await r.text()
+            exp = (await r.json())["explanations"][0]
+            # exact linearity: sum(contributions) + intercept == decision fn
+            total = sum(exp["contributions"]) + exp["intercept"][0]
+            want = float(clf.decision_function([[1.0, 2.0, 3.0]])[0])
+            assert abs(total - want) < 1e-6
+            # predict still works through the composed service
+            r = await client.post("/v1/models/sk:predict", json=body)
+            assert r.status == 200
+
+            # a model with no explainer answers 501, not 500
+            r = await client.post(
+                "/v1/models/sk:predict".replace(":predict", ":explain"),
+                json=body,
+            )
+            assert r.status == 200  # this one HAS an explainer
+
+    asyncio.run(run())
+
+
+def test_explain_without_explainer_is_501():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    server = ModelServer([_Doubler("dbl")])
+
+    async def run():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post(
+                "/v1/models/dbl:explain", json={"instances": [[1]]}
+            )
+            assert r.status == 501
+
+    asyncio.run(run())
