@@ -1,0 +1,42 @@
+"""Subprocess accelerator liveness probe.
+
+The chip in this environment is reached through a tunnel; when the tunnel
+wedges, EVERY in-process device touch — including ``jax.default_backend()``
+during backend init — blocks forever. Anything that must not hang (the
+bench driver, the chip test suite) probes from a SUBPROCESS with a hard
+timeout before touching jax devices in-process. One shared implementation
+so "unreachable" means the same thing everywhere.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+#: prints the backend AND runs one op — a wedged tunnel hangs either the
+#: backend init or the execute; both are caught by the subprocess timeout.
+_PROBE = (
+    "import jax, numpy as np;"
+    "print('backend:' + jax.default_backend(), flush=True);"
+    "x = jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8));"
+    "print('value:' + str(float(np.asarray(x)[0, 0])))"
+)
+
+UNREACHABLE = "unreachable"
+
+
+def probe_backend(timeout_s: float = 120.0) -> str:
+    """Returns the backend name ("cpu", "tpu", …) or ``UNREACHABLE``."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE], timeout=timeout_s,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return UNREACHABLE
+    if out.returncode != 0 or "value:" not in out.stdout:
+        return UNREACHABLE
+    for line in out.stdout.splitlines():
+        if line.startswith("backend:"):
+            return line.split(":", 1)[1]
+    return UNREACHABLE

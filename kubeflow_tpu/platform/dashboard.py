@@ -306,8 +306,16 @@ class DashboardServer(ThreadedAiohttpServer):
                     raise web.HTTPUnsupportedMediaType(
                         reason="state-changing requests must be application/json"
                     )
-                host = request.headers.get("host", "").split(":")[0]
-                if host not in (self.host, "localhost", "127.0.0.1", "[::1]"):
+                raw_host = request.headers.get("host", "")
+                if raw_host.startswith("["):  # IPv6 literal: [::1]:8080
+                    host = raw_host.split("]")[0] + "]"
+                else:
+                    host = raw_host.rsplit(":", 1)[0]
+                allowed = {self.host, "localhost", "127.0.0.1", "[::1]"}
+                # a wildcard bind can't pin one hostname; the operator opted
+                # out of the loopback posture, so skip the pin (the JSON
+                # content-type requirement still blocks no-preflight CSRF)
+                if self.host not in ("0.0.0.0", "::") and host not in allowed:
                     raise web.HTTPForbidden(reason=f"bad host {host!r}")
             return await handler(request)
 

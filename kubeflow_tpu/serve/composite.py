@@ -84,9 +84,12 @@ class ComposedService(Model):
         return out
 
     def explain(self, payload: Any, headers=None) -> Any:
+        # the transformer brackets EVERY verb's input, matching predict —
+        # an explainer (or a predictor's own explain) must see the same
+        # transformed payload the model scores
+        if self.transformer is not None:
+            payload = self.transformer.preprocess(payload, headers)
         if self.explainer is not None:
-            if self.transformer is not None:
-                payload = self.transformer.preprocess(payload, headers)
             return self.explainer.explain(payload, headers)
         return self.predictor.explain(payload, headers)
 

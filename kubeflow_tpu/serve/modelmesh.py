@@ -90,6 +90,9 @@ class ModelMesh:
         #: storage flakes must not be a permanent 503 — see MeshBackedModel)
         self.retry_cooldown_s = retry_cooldown_s
         self._entries: dict[str, _Entry] = {}
+        #: deregistered-while-pinned entries: their weights are STILL in HBM
+        #: until the last unpin drains them, so budget math must see them
+        self._draining: list[_Entry] = []
         self.stats: dict[str, int] = {
             "loads": 0, "evictions": 0, "hits": 0, "misses": 0,
         }
@@ -119,8 +122,10 @@ class ModelMesh:
             self._entries.pop(name)
             if e.pins > 0:
                 # an in-flight request holds the weights: unloading now
-                # would free params mid-forward — the last unpin drains it
+                # would free params mid-forward — the last unpin drains it,
+                # and _draining keeps the bytes visible to budget math
                 e.draining = True
+                self._draining.append(e)
                 return
             model, e.model = e.model, None
         if model is not None:
@@ -154,7 +159,7 @@ class ModelMesh:
             return sum(
                 e.bytes for e in self._entries.values()
                 if e.state == ModelState.LOADED
-            )
+            ) + sum(e.bytes for e in self._draining)
 
     def readiness(self, name: str) -> Mapping[str, Any]:
         with self._lock:
@@ -292,6 +297,9 @@ class ModelMesh:
                     e.pins -= 1
                     if e.draining and e.pins == 0:
                         drain, e.model = e.model, None
+                        e.bytes = 0
+                        if e in self._draining:
+                            self._draining.remove(e)
                 if drain is not None:
                     drain.unload()
 
@@ -367,6 +375,10 @@ class MeshBackedModel(Model):
     def postprocess(self, outputs: Any, headers=None) -> Any:
         with self._mesh.pinned(self.key) as m:
             return m.postprocess(outputs, headers)
+
+    def explain(self, payload: Any, headers=None) -> Any:
+        with self._mesh.pinned(self.key) as m:
+            return m.explain(payload, headers)
 
     async def __call__(self, payload: Any, headers=None) -> Any:
         with self._mesh.pinned(self.key) as m:

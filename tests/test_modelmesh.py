@@ -362,3 +362,27 @@ def test_deregister_while_pinned_drains_at_unpin():
         assert out.shape[0] == 1
     # after unpin the drained weights are gone
     assert model._params is None
+
+
+def test_explain_through_mesh_backed_model():
+    """:explain must reach the underlying runtime through the mesh proxy
+    (the density mode), not die at Model.explain's 501 stub."""
+
+    class Attrib(JAXModel):
+        def explain(self, payload, headers=None):
+            return {"explanations": ["ok"]}
+
+    def factory():
+        import jax.numpy as jnp
+
+        m = Attrib(
+            "a",
+            lambda p, i, mk: p["w"][i % p["w"].shape[0]].sum(-1),
+            lambda: {"w": jnp.ones((32, 32), jnp.float32)},
+            buckets=BucketSpec(batch_sizes=(1,), seq_lens=(8,)),
+        )
+        return m
+
+    mesh = ModelMesh(4 * PER_MODEL)
+    proxy = MeshBackedModel(mesh, "a", factory)
+    assert proxy.explain({"instances": [[1]]}) == {"explanations": ["ok"]}

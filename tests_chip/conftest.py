@@ -5,12 +5,21 @@ and skips itself entirely when no TPU is available. Run:
     python -m pytest tests_chip -q
 """
 
-import jax
 import pytest
+
+from kubeflow_tpu.core.deviceprobe import probe_backend as _probe_backend
 
 
 def pytest_collection_modifyitems(config, items):
-    if jax.default_backend() == "cpu":
-        skip = pytest.mark.skip(reason="no TPU backend; chip suite skipped")
-        for item in items:
-            item.add_marker(skip)
+    if not items:
+        return
+    backend = _probe_backend()
+    if backend == "cpu":
+        reason = "no TPU backend; chip suite skipped"
+    elif backend == "unreachable":
+        reason = "TPU unreachable (tunnel probe timed out); chip suite skipped"
+    else:
+        return
+    skip = pytest.mark.skip(reason=reason)
+    for item in items:
+        item.add_marker(skip)
