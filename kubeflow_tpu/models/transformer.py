@@ -153,7 +153,15 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(
+        self,
+        x,
+        positions,
+        segment_ids=None,
+        layer_cache=None,
+        cache_index=None,
+        kv_mask=None,
+    ):
         cfg = self.cfg
         B, S, _ = x.shape
         H, D = cfg.n_heads, cfg.head_dim
@@ -166,12 +174,53 @@ class Attention(nn.Module):
         if cfg.use_rope:
             q, k = rope(q, positions), rope(k, positions)
 
-        o = dispatch_attention(q, k, v, cfg, segment_ids=segment_ids)
+        new_cache = None
+        if layer_cache is not None:
+            # Autoregressive decode path (SURVEY.md §2.2 "vLLM backend"
+            # analog): keys/values accumulate in an explicit functional
+            # cache — (B, H, max_len, D) — threaded through apply(), never
+            # flax mutable state. Already-roped keys are cached, so decode
+            # steps pay one GEMV against the cache, not a re-prefill.
+            K = jax.lax.dynamic_update_slice(
+                layer_cache["k"], k.astype(layer_cache["k"].dtype),
+                (0, 0, cache_index, 0),
+            )
+            V = jax.lax.dynamic_update_slice(
+                layer_cache["v"], v.astype(layer_cache["v"].dtype),
+                (0, 0, cache_index, 0),
+            )
+            new_cache = {"k": K, "v": V}
+            T = K.shape[2]
+            kpos = jnp.arange(T)
+            if kv_mask is None:
+                # default: plain causal over absolute slots (prefill)
+                qpos = cache_index + jnp.arange(S)
+                mask = (kpos[None, :] <= qpos[:, None])[None, :, :]  # (1,S,T)
+                mask = jnp.broadcast_to(mask, (B, S, T))
+            else:
+                mask = jnp.broadcast_to(kv_mask[:, None, :], (B, S, T))
+            scale = 1.0 / jnp.sqrt(jnp.float32(D))
+            scores = (
+                jnp.einsum(
+                    "bhsd,bhtd->bhst",
+                    q.astype(jnp.float32),
+                    K.astype(jnp.float32),
+                )
+                * scale
+            )
+            scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
+            o = jnp.einsum("bhst,bhtd->bhsd", probs, V)
+        else:
+            o = dispatch_attention(q, k, v, cfg, segment_ids=segment_ids)
 
         o = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
-        return nn.Dense(
+        out = nn.Dense(
             cfg.d_model, use_bias=False, dtype=cfg.dtype, name="o_proj"
         )(o)
+        if layer_cache is not None:
+            return out, new_cache
+        return out
 
 
 def dispatch_attention(q, k, v, cfg: TransformerConfig, *, segment_ids=None):
@@ -290,11 +339,26 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(
+        self,
+        x,
+        positions,
+        segment_ids=None,
+        layer_cache=None,
+        cache_index=None,
+        kv_mask=None,
+    ):
         cfg = self.cfg
-        h = Attention(cfg, name="attn")(
-            RMSNorm(name="ln1")(x), positions, segment_ids
-        )
+        new_cache = None
+        attn_in = RMSNorm(name="ln1")(x)
+        if layer_cache is not None:
+            h, new_cache = Attention(cfg, name="attn")(
+                attn_in, positions, segment_ids,
+                layer_cache=layer_cache, cache_index=cache_index,
+                kv_mask=kv_mask,
+            )
+        else:
+            h = Attention(cfg, name="attn")(attn_in, positions, segment_ids)
         x = _act_constraint(x + h)
         y = RMSNorm(name="ln2")(x)
         if self.use_moe:
@@ -303,7 +367,10 @@ class Block(nn.Module):
             y = out.reshape(B, S, d)
         else:
             y = Mlp(cfg, name="mlp")(y)
-        return _act_constraint(x + y)
+        out = _act_constraint(x + y)
+        if layer_cache is not None:
+            return out, new_cache
+        return out
 
 
 class TransformerLM(nn.Module):
@@ -312,12 +379,27 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, segment_ids=None, positions=None):
+    def __call__(
+        self,
+        tokens,
+        *,
+        segment_ids=None,
+        positions=None,
+        cache=None,
+        cache_index=None,
+        kv_mask=None,
+    ):
+        """Training/scoring: ``(tokens) -> logits``. Autoregressive serving:
+        pass ``cache`` (from :func:`init_kv_cache`) + ``cache_index`` →
+        ``(logits, new_cache)``; prefill writes slots [idx, idx+S), decode
+        steps pass S=1. ``kv_mask`` (B, max_len) marks which cache slots a
+        query may attend (ragged-prompt batches exclude padding slots)."""
         cfg = self.cfg
         cfg.validate()
         B, S = tokens.shape
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+            start = 0 if cache_index is None else cache_index
+            positions = jnp.broadcast_to(start + jnp.arange(S), (B, S))
         x = Embedding(
             cfg.vocab_size, cfg.d_model,
             dtype=cfg.dtype, impl=cfg.embed_impl, name="embed",
@@ -328,19 +410,44 @@ class TransformerLM(nn.Module):
                 nn.initializers.normal(0.02),
                 (cfg.max_seq_len, cfg.d_model),
             )
-            x = x + pos_emb[None, :S].astype(cfg.dtype)
+            x = x + jnp.take(pos_emb, positions, axis=0).astype(cfg.dtype)
         x = _act_constraint(x)
 
         BlockCls = nn.remat(Block) if cfg.remat else Block
+        new_cache = {} if cache is not None else None
         for i in range(cfg.n_layers):
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
-            x = BlockCls(cfg, use_moe=use_moe, name=f"layers_{i}")(
-                x, positions, segment_ids
-            )
+            block = BlockCls(cfg, use_moe=use_moe, name=f"layers_{i}")
+            if cache is not None:
+                x, new_cache[f"layers_{i}"] = block(
+                    x, positions, segment_ids,
+                    layer_cache=cache[f"layers_{i}"],
+                    cache_index=cache_index,
+                    kv_mask=kv_mask,
+                )
+            else:
+                x = block(x, positions, segment_ids)
         x = RMSNorm(name="ln_f")(x)
-        return nn.Dense(
+        logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="unembed"
         )(x)
+        if cache is not None:
+            return logits, new_cache
+        return logits
+
+
+def init_kv_cache(
+    cfg: TransformerConfig, batch: int, max_len: int, dtype: Any | None = None
+) -> dict:
+    """Zeroed decode cache: one (B, H, max_len, head_dim) K and V per layer."""
+    dtype = dtype or cfg.dtype
+    shape = (batch, cfg.n_heads, max_len, cfg.head_dim)
+    return {
+        f"layers_{i}": {
+            "k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)
+        }
+        for i in range(cfg.n_layers)
+    }
 
 
 # --------------------------------------------------------------------------- #

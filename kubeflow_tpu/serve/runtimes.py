@@ -212,9 +212,18 @@ class BertRuntimeModel(JAXModel):
 
 
 def default_registry() -> RuntimeRegistry:
+    from kubeflow_tpu.serve.generate import LMRuntimeModel
     from kubeflow_tpu.serve.sklearn_runtime import SklearnRuntimeModel
 
     reg = RuntimeRegistry()
+    reg.register(
+        ServingRuntime(
+            name="kubeflow-tpu-causal-lm",
+            supported_formats=("causal-lm", "llm"),
+            factory=LMRuntimeModel,
+            priority=1,
+        )
+    )
     reg.register(
         ServingRuntime(
             name="kubeflow-tpu-sklearn",

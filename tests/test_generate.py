@@ -1,0 +1,235 @@
+"""Generative serving: KV-cache decode + scan generation (SURVEY.md §2.2
+HuggingFace-runtime "vLLM backend" row, TPU-native re-design)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    init_kv_cache,
+)
+from kubeflow_tpu.serve.generate import LMRuntimeModel, make_generate_fn
+from kubeflow_tpu.serve.model import BucketSpec
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        attn_impl="reference", dtype=jnp.float32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _params(model, rng=0):
+    return model.init(jax.random.PRNGKey(rng), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+
+
+def test_kv_cache_decode_matches_full_forward(devices8):
+    """Teacher-forced: prefill+stepwise decode logits == one full forward."""
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = _params(model)
+    B, S, P, MAX = 2, 12, 7, 16
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size)
+    full = model.apply({"params": params}, toks)
+
+    cache = init_kv_cache(cfg, B, MAX)
+    lg, cache = model.apply(
+        {"params": params}, toks[:, :P], cache=cache, cache_index=0
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(full[:, :P]), rtol=2e-5, atol=1e-5
+    )
+    for t in range(P, S):
+        kv_mask = jnp.broadcast_to(jnp.arange(MAX) <= t, (B, MAX))
+        lg, cache = model.apply(
+            {"params": params}, toks[:, t : t + 1],
+            cache=cache, cache_index=t, kv_mask=kv_mask,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]),
+            rtol=2e-5, atol=1e-5, err_msg=f"decode step {t}",
+        )
+
+
+def test_learned_positions_cache_decode(devices8):
+    """The BERT-style learned-position path must also decode correctly
+    (positions gathered per row, not sliced by sequence length)."""
+    cfg = _cfg(use_rope=False, max_seq_len=64)
+    model = TransformerLM(cfg)
+    params = _params(model)
+    B, S, P, MAX = 1, 8, 5, 12
+    toks = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, cfg.vocab_size)
+    full = model.apply({"params": params}, toks)
+    cache = init_kv_cache(cfg, B, MAX)
+    lg, cache = model.apply(
+        {"params": params}, toks[:, :P], cache=cache, cache_index=0
+    )
+    for t in range(P, S):
+        kv_mask = jnp.broadcast_to(jnp.arange(MAX) <= t, (B, MAX))
+        lg, cache = model.apply(
+            {"params": params}, toks[:, t : t + 1],
+            cache=cache, cache_index=t, kv_mask=kv_mask,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]),
+            rtol=2e-5, atol=1e-5,
+        )
+
+
+def test_greedy_generation_matches_full_forward_loop(devices8):
+    """The scan generator must equal the naive generate-by-full-forward
+    loop (greedy), including ragged prompts in one padded batch."""
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = _params(model)
+    max_new = 6
+    gen = jax.jit(
+        make_generate_fn(model, cfg, max_new_tokens=max_new, eos_id=63)
+    )
+
+    prompts = [[5, 9, 17], [3, 30, 41, 28, 11]]
+    P = 8
+    prompt = np.zeros((2, P), np.int32)
+    plen = np.zeros((2,), np.int32)
+    for i, p in enumerate(prompts):
+        prompt[i, : len(p)] = p
+        plen[i] = len(p)
+    out, n_valid = gen(
+        params, prompt, plen, jax.random.PRNGKey(0),
+        jnp.zeros((2,), jnp.float32),
+    )
+    out, n_valid = np.asarray(out), np.asarray(n_valid)
+
+    # naive reference: argmax over a full forward of the growing sequence
+    for i, p in enumerate(prompts):
+        seq = list(p)
+        for _ in range(max_new):
+            logits = model.apply(
+                {"params": params}, jnp.asarray([seq], jnp.int32)
+            )
+            nxt = int(jnp.argmax(logits[0, -1]))
+            if nxt == 63:
+                break
+            seq.append(nxt)
+        want = seq[len(p):]
+        got = [int(t) for t in out[i, : n_valid[i]]]
+        assert got == want, (i, got, want)
+
+
+def test_generation_stops_at_eos_and_pads(devices8):
+    """Rows that hit EOS emit pad from then on (no data-dependent exit)."""
+    cfg = _cfg(vocab_size=8)
+    model = TransformerLM(cfg)
+    params = _params(model)
+    gen = jax.jit(make_generate_fn(model, cfg, max_new_tokens=5, eos_id=0))
+    # eos_id == pad: every sampled 0 terminates; just assert shape/validity
+    out, n_valid = gen(
+        params,
+        np.asarray([[1, 2, 3, 0]], np.int32),
+        np.asarray([3], np.int32),
+        jax.random.PRNGKey(0),
+        jnp.zeros((1,), jnp.float32),
+    )
+    assert np.asarray(out).shape == (1, 5)
+    assert 0 <= int(n_valid[0]) <= 5
+
+
+def test_lm_runtime_serves_v1_and_buckets(devices8):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.serve.server import ModelServer
+
+    m = LMRuntimeModel(
+        "lm", None, config=_cfg(),
+        buckets=BucketSpec(batch_sizes=(1, 2), seq_lens=(8,)),
+        max_new_tokens=4,
+    )
+    m.load()
+    server = ModelServer([m])
+
+    async def run():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post(
+                "/v1/models/lm:predict",
+                json={"instances": ["hello world", {"input_ids": [4, 5, 6],
+                                                   "temperature": 0.7}]},
+            )
+            assert r.status == 200, await r.text()
+            preds = (await r.json())["predictions"]
+            assert len(preds) == 2
+            for p in preds:
+                assert 0 < len(p["token_ids"]) <= 4
+                assert all(isinstance(t, int) for t in p["token_ids"])
+
+    asyncio.run(run())
+
+
+def test_lm_runtime_through_default_registry(devices8):
+    from kubeflow_tpu.serve.runtimes import default_registry
+    from kubeflow_tpu.serve.spec import PredictorSpec
+
+    rt = default_registry().resolve(PredictorSpec(model_format="causal-lm"))
+    m = rt.factory("gen", None, config=_cfg(), max_new_tokens=3)
+    m.load()
+    out = m.postprocess(m.predict(m.preprocess({"instances": ["hi"]})))
+    assert len(out["predictions"][0]["token_ids"]) <= 3
+
+
+def test_sampled_generation_varies_with_temperature(devices8):
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = _params(model)
+    gen = jax.jit(make_generate_fn(model, cfg, max_new_tokens=8, eos_id=63))
+    prompt = np.asarray([[7, 13, 21, 0, 0, 0, 0, 0]], np.int32)
+    plen = np.asarray([3], np.int32)
+    t0 = jnp.zeros((1,), jnp.float32)
+    t15 = jnp.full((1,), 1.5, jnp.float32)
+    greedy = np.asarray(gen(params, prompt, plen, jax.random.PRNGKey(0), t0)[0])
+    samples = {
+        tuple(np.asarray(gen(params, prompt, plen, jax.random.PRNGKey(s), t15)[0])[0])
+        for s in range(6)
+    }
+    assert len(samples) > 1, "temperature sampling produced no diversity"
+    greedy2 = np.asarray(gen(params, prompt, plen, jax.random.PRNGKey(9), t0)[0])
+    np.testing.assert_array_equal(greedy, greedy2)  # greedy is rng-invariant
+
+
+def test_per_row_temperature_honored_in_one_batch(devices8):
+    """A greedy request co-batched with a sampling request must stay
+    deterministic (per-row temperature, not batch max)."""
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = _params(model)
+    gen = jax.jit(make_generate_fn(model, cfg, max_new_tokens=6, eos_id=63))
+    prompt = np.asarray([[7, 13, 21, 0], [4, 4, 4, 4]], np.int32)
+    plen = np.asarray([3, 4], np.int32)
+    temps = jnp.asarray([0.0, 2.0], jnp.float32)
+    runs = [
+        np.asarray(gen(params, prompt, plen, jax.random.PRNGKey(s), temps)[0])
+        for s in range(4)
+    ]
+    # row 0 (greedy) identical across rngs; row 1 (sampled) varies
+    for r in runs[1:]:
+        np.testing.assert_array_equal(r[0], runs[0][0])
+    assert len({tuple(r[1]) for r in runs}) > 1
+
+
+def test_learned_positions_overflow_fails_loudly(devices8):
+    from kubeflow_tpu.serve.generate import LMRuntimeModel
+
+    cfg = _cfg(use_rope=False, max_seq_len=16)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        LMRuntimeModel(
+            "lm", None, config=cfg,
+            buckets=BucketSpec(batch_sizes=(1,), seq_lens=(8,)),
+            max_new_tokens=32,
+        )

@@ -81,3 +81,55 @@ def test_metric_writer_alarm_fires_on_every_rank():
         w.write(7, {"loss": float("nan")})
     w2 = MetricWriter(None, is_writer=True, nan_alarm=False)
     w2.write(7, {"loss": float("nan")})  # explicit opt-out stays silent
+
+
+def test_loss_invariant_across_mesh_shapes(devices8):
+    """SPMD determinism (SURVEY.md §5.2): the SAME model/seed/data must
+    produce the same losses whether the 8 devices are laid out as pure DP,
+    pure FSDP, hybrid DP x FSDP, or with tensor parallelism — resharding
+    must never change the math."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.data.synthetic import TokenLMDataset
+    from kubeflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from kubeflow_tpu.models.transformer import make_init_fn as t_init
+    from kubeflow_tpu.models.transformer import make_loss_fn as t_loss
+    from kubeflow_tpu.parallel.sharding import transformer_rules
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        attn_impl="reference", dtype=jnp.float32, embed_impl="onehot",
+    )
+    ds = TokenLMDataset(vocab_size=128, seq_len=32)
+
+    def run(spec):
+        model = TransformerLM(cfg)
+        trainer = Trainer(
+            init_params=t_init(model, 32, 8),
+            loss_fn=t_loss(model),
+            optimizer=optax.adamw(1e-3),
+            config=TrainConfig(
+                mesh=spec, global_batch=16, steps=3, log_every=1,
+            ),
+            param_spec_fn=transformer_rules(),
+        )
+        _, history = trainer.fit(
+            lambda s: local_shard_iterator(ds, 16, start_step=s)
+        )
+        return [h["loss"] for h in history]
+
+    losses = {
+        "dp8": run(MeshSpec(data=8)),
+        "fsdp8": run(MeshSpec(fsdp=8)),
+        "dp2xfsdp4": run(MeshSpec(data=2, fsdp=4)),
+        "fsdp4xtp2": run(MeshSpec(fsdp=4, model=2)),
+    }
+    ref = losses["dp8"]
+    for name, ls in losses.items():
+        np.testing.assert_allclose(
+            ls, ref, rtol=2e-5,
+            err_msg=f"mesh layout {name} changed the training math",
+        )
