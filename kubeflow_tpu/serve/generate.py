@@ -124,6 +124,56 @@ def make_generate_fn(
     return generate
 
 
+def _restore_lm_params(storage_path: str):
+    """Accepts BOTH checkpoint layouts a user will actually have:
+
+    1. a ``train.Checkpointer`` directory (Orbax CheckpointManager: step
+       subdirs holding the full TrainState) — the train→serve handoff:
+       restore the latest step, take its ``params``;
+    2. a bare ``StandardCheckpointer`` params directory.
+    """
+    import os
+
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(storage_path)
+    if not os.path.isdir(path):
+        # fail closed with the true cause — and never let the manager probe
+        # mkdir a mistyped/unmounted path into existence
+        raise RuntimeError(
+            f"LM storage_path {path!r} does not exist (failed mount / typo?)"
+        )
+    step = None
+    mgr = None
+    try:
+        mgr = ocp.CheckpointManager(
+            path, options=ocp.CheckpointManagerOptions(create=False)
+        )
+        step = mgr.latest_step()
+    except Exception:  # noqa: BLE001 — not a manager layout; bare fallback
+        step = None
+    if step is not None:
+        # a genuine train checkpoint: restore errors are REAL and must
+        # surface (corrupt step, version mismatch), not be masked by a
+        # nonsensical bare-layout fallback error
+        try:
+            tree = mgr.restore(step)
+        except Exception as e:
+            raise RuntimeError(
+                f"LM storage_path {path!r} is a train checkpoint "
+                f"(latest step {step}) but restoring it failed: {e}"
+            ) from e
+        finally:
+            mgr.close()
+        if isinstance(tree, Mapping) and "params" in tree:
+            return tree["params"]
+        return tree
+    if mgr is not None:
+        mgr.close()
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path)
+
+
 class LMRuntimeModel(Model):
     """Causal-LM serving runtime: text/ids in → generated ids (+text) out.
 
@@ -178,12 +228,7 @@ class LMRuntimeModel(Model):
 
     def load(self) -> bool:
         if self._storage_path is not None:
-            import os
-
-            import orbax.checkpoint as ocp
-
-            with ocp.StandardCheckpointer() as ckptr:
-                params = ckptr.restore(os.path.abspath(self._storage_path))
+            params = _restore_lm_params(self._storage_path)
         else:  # fresh weights: latency benchmarking / tests
             params = self._model.init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)

@@ -233,3 +233,61 @@ def test_learned_positions_overflow_fails_loudly(devices8):
             buckets=BucketSpec(batch_sizes=(1,), seq_lens=(8,)),
             max_new_tokens=32,
         )
+
+
+def test_train_checkpoint_serves_through_lm_runtime(tmp_path, devices8):
+    """The train -> serve handoff: a Trainer-written Orbax checkpoint of
+    the flagship LM serves directly as the causal-lm runtime's weights."""
+    import optax
+
+    from kubeflow_tpu.core.mesh import MeshSpec
+    from kubeflow_tpu.data.synthetic import TokenLMDataset, local_shard_iterator
+    from kubeflow_tpu.models.transformer import make_init_fn, make_loss_fn
+    from kubeflow_tpu.train.checkpoint import CheckpointConfig
+    from kubeflow_tpu.train.loop import TrainConfig, Trainer
+
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    trainer = Trainer(
+        init_params=make_init_fn(model, 16, 8),
+        loss_fn=make_loss_fn(model),
+        optimizer=optax.adamw(1e-3),
+        config=TrainConfig(
+            mesh=MeshSpec.data_parallel(8),
+            global_batch=16,
+            steps=3,
+            log_every=10,
+            checkpoint=CheckpointConfig(
+                directory=str(tmp_path / "ckpt"),
+                save_every_steps=1,
+                async_save=False,
+            ),
+        ),
+    )
+    ds = TokenLMDataset(vocab_size=cfg.vocab_size, seq_len=16)
+    state, _ = trainer.fit(
+        lambda s: local_shard_iterator(ds, 16, start_step=s)
+    )
+
+    m = LMRuntimeModel(
+        "chat", str(tmp_path / "ckpt"), config=cfg, max_new_tokens=4,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(8,)),
+    )
+    m.load()
+    # the served weights ARE the trained weights, not a fresh init
+    trained = np.asarray(
+        jax.device_get(state.params["unembed"]["kernel"])
+    )
+    served = np.asarray(jax.device_get(m._params["unembed"]["kernel"]))
+    np.testing.assert_allclose(served, trained, rtol=1e-6)
+    out = m.postprocess(m.predict(m.preprocess({"instances": [[3, 5, 7]]})))
+    assert len(out["predictions"][0]["token_ids"]) <= 4
+
+
+def test_lm_missing_storage_path_fails_closed(tmp_path, devices8):
+    m = LMRuntimeModel("lm", str(tmp_path / "nope"), config=_cfg())
+    with pytest.raises(RuntimeError, match="does not exist"):
+        m.load()
+    assert not m.ready
+    # the probe must not have conjured the directory into existence
+    assert not (tmp_path / "nope").exists()
