@@ -703,6 +703,108 @@ def _torch_bert_infer_p50() -> float:
 
 
 # --------------------------------------------------------------------------- #
+# config 6 (beyond BASELINE): generative LM decode throughput — the
+# huggingfaceserver/vLLM analog (SURVEY.md §2.2), whole-generation-on-device
+# --------------------------------------------------------------------------- #
+
+
+def bench_generate() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+    from kubeflow_tpu.serve.generate import make_generate_fn
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = TransformerConfig(
+        vocab_size=32768,
+        d_model=1024,
+        n_layers=12,
+        n_heads=16,
+        d_ff=4096,
+        attn_impl="flash" if on_tpu else "reference",
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    batch, prompt_len, max_new = 8, 128, 64
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    params = jax.device_put(params)
+    prompt = np.ones((batch, prompt_len), np.int32)
+    plen = np.full((batch,), prompt_len, np.int32)
+    temps = np.zeros((batch,), np.float32)
+
+    def timed(gen, seed):
+        t0 = time.perf_counter()
+        _, n_valid = gen(params, prompt, plen, jax.random.PRNGKey(seed), temps)
+        np.asarray(n_valid)  # host transfer = real sync on the tunnel
+        return time.perf_counter() - t0
+
+    # two generation lengths: the difference isolates pure decode steps
+    # (prefill and the constant tunnel RTT both cancel)
+    short_new = 16
+    gen_long = jax.jit(
+        make_generate_fn(model, cfg, max_new_tokens=max_new, eos_id=1)
+    )
+    gen_short = jax.jit(
+        make_generate_fn(model, cfg, max_new_tokens=short_new, eos_id=1)
+    )
+    timed(gen_long, 0)
+    timed(gen_short, 0)  # compiles
+    t_long = min(timed(gen_long, s) for s in (1, 2))
+    t_short = min(timed(gen_short, s) for s in (1, 2))
+    step_s = (t_long - t_short) / (max_new - short_new)
+    prefill_s = max(t_short - short_new * step_s, 0.0)
+    tok_per_s = batch * max_new / t_long  # aggregate: prefill amortized
+
+    torch_tps = _torch_generate_tps(batch=batch)
+    return {
+        "metric": "lm_decode_throughput",
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tok_per_s / torch_tps, 3),
+        "detail": {
+            "ms_per_decode_step": round(step_s * 1e3, 3),
+            "prefill_ms": round(prefill_s * 1e3, 2),
+            "batch": batch,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new,
+            "model": "1024d x 12L (~200M params)",
+            "dtype": "bfloat16" if on_tpu else "float32",
+            "design": "prefill + lax.scan decode, one device program",
+            "reference_torch_cpu_tokens_per_s": round(torch_tps, 1),
+            "baseline_is": (
+                "torch GPT-2-class greedy generate, SAME batch, CPU; "
+                "both sides aggregate tokens/s with prefill amortized"
+            ),
+        },
+    }
+
+
+def _torch_generate_tps(batch: int = 8) -> float:
+    """Reference side: HF torch GPT-2-class greedy generation on CPU at the
+    SAME batch size (decode throughput scales ~linearly with batch; a
+    batch-1 reference would inflate vs_baseline by ~batch x)."""
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(0)
+    net = GPT2LMHeadModel(
+        GPT2Config(n_embd=1024, n_layer=12, n_head=16, vocab_size=32768)
+    ).eval()
+    ids = torch.ones((batch, 128), dtype=torch.long)
+    new = 32
+    with torch.no_grad():
+        net.generate(ids, max_new_tokens=2, do_sample=False)  # warm caches
+        t0 = time.perf_counter()
+        net.generate(ids, max_new_tokens=new, do_sample=False)
+        dt = time.perf_counter() - t0
+    return batch * new / dt
+
+
+# --------------------------------------------------------------------------- #
 
 
 def _probe_backend(timeout_s: float = 120.0) -> str:
@@ -714,11 +816,16 @@ def _probe_backend(timeout_s: float = 120.0) -> str:
 
 
 def main() -> int:
-    device_benches = (bench_mnist, bench_resnet, bench_bert, bench_serving)
+    device_benches = (
+        bench_mnist, bench_resnet, bench_bert, bench_serving, bench_generate
+    )
     backend = _probe_backend()
     alive = backend != "unreachable"
     results: list[dict] = []
-    for fn in (bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving):
+    for fn in (
+        bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving,
+        bench_generate,
+    ):
         if fn in device_benches and not alive:
             r = {
                 "metric": fn.__name__.replace("bench_", "") + "_unavailable",
@@ -781,6 +888,7 @@ def main() -> int:
                             "cold_start_s",
                             "rest_p99_ms",
                             "grpc_p50_ms",
+                            "ms_per_decode_step",
                             "error",
                         )
                     },

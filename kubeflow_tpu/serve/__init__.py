@@ -17,6 +17,14 @@ Layout mirrors the reference's separation of concerns:
 - ``spec``       — ``InferenceService`` / ``ServingRuntime`` declarative specs.
 - ``controller`` — InferenceService reconciler: replicas, autoscaling,
                    scale-to-zero, canary traffic split.
+- ``composite``  — transformer/explainer components composed in-process
+                   around the predictor (the KServe component pods, collapsed).
+- ``modelmesh``  — ModelMesh-class multi-model density: N registered models
+                   under one HBM budget with LRU load/unload and pinning.
+- ``generate``   — generative causal-LM runtime: KV-cache decode, whole
+                   generation as one jitted prefill+scan program.
+- ``sklearn_runtime`` — pickled sklearn estimators (linear family on the
+                   MXU, trees on host), exact linear ``:explain``.
 - ``graph``      — ``InferenceGraph`` sequence/switch/ensemble/splitter routing.
 """
 
@@ -28,6 +36,8 @@ from kubeflow_tpu.serve.spec import (
     ServingRuntime,
 )
 from kubeflow_tpu.serve.controller import InferenceServiceController
+from kubeflow_tpu.serve.composite import ComposedService
+from kubeflow_tpu.serve.modelmesh import MeshBackedModel, ModelMesh
 
 __all__ = [
     "Model",
@@ -39,4 +49,7 @@ __all__ = [
     "PredictorSpec",
     "ServingRuntime",
     "InferenceServiceController",
+    "ComposedService",
+    "MeshBackedModel",
+    "ModelMesh",
 ]
