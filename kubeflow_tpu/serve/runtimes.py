@@ -214,8 +214,17 @@ class BertRuntimeModel(JAXModel):
 def default_registry() -> RuntimeRegistry:
     from kubeflow_tpu.serve.generate import LMRuntimeModel
     from kubeflow_tpu.serve.sklearn_runtime import SklearnRuntimeModel
+    from kubeflow_tpu.serve.xgboost_runtime import XGBoostRuntimeModel
 
     reg = RuntimeRegistry()
+    reg.register(
+        ServingRuntime(
+            name="kubeflow-tpu-xgboost",
+            supported_formats=("xgboost",),
+            factory=XGBoostRuntimeModel,
+            priority=1,
+        )
+    )
     reg.register(
         ServingRuntime(
             name="kubeflow-tpu-causal-lm",

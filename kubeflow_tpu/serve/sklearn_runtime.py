@@ -29,32 +29,15 @@ from typing import Any, Mapping
 import numpy as np
 
 from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.serve.tabular import coerce_tabular_payload, find_model_file
 
 
 def _find_model_file(storage_path: str) -> str:
-    if os.path.isfile(storage_path):
-        return storage_path
-    if os.path.isdir(storage_path):
-        preferred = [
-            os.path.join(storage_path, n)
-            for n in ("model.joblib", "model.pkl", "model.pickle")
-        ]
-        for p in preferred:
-            if os.path.isfile(p):
-                return p
-        candidates = [
-            os.path.join(storage_path, n)
-            for n in sorted(os.listdir(storage_path))
-            if n.endswith((".joblib", ".pkl", ".pickle"))
-        ]
-        if len(candidates) == 1:
-            return candidates[0]
-        if candidates:
-            raise RuntimeError(
-                f"ambiguous sklearn model dir {storage_path!r}: {candidates}"
-            )
-    raise RuntimeError(
-        f"no sklearn model file (*.joblib/*.pkl) under {storage_path!r}"
+    return find_model_file(
+        storage_path,
+        preferred=("model.joblib", "model.pkl", "model.pickle"),
+        suffixes=(".joblib", ".pkl", ".pickle"),
+        kind="sklearn",
     )
 
 
@@ -134,18 +117,7 @@ class SklearnRuntimeModel(Model):
     # -- data path ----------------------------------------------------------- #
 
     def preprocess(self, payload: Any, headers: Mapping[str, str] | None = None):
-        if isinstance(payload, Mapping) and isinstance(payload.get("inputs"), Mapping):
-            tensors = payload["inputs"]
-            arr = np.asarray(next(iter(tensors.values())), np.float32)
-        elif isinstance(payload, Mapping) and "instances" in payload:
-            arr = np.asarray(payload["instances"], np.float32)
-        else:
-            arr = np.asarray(payload, np.float32)
-        if arr.ndim == 1:
-            arr = arr[None, :]
-        if arr.ndim != 2:
-            raise ValueError(f"expected (batch, features); got {arr.shape}")
-        return arr
+        return coerce_tabular_payload(payload)
 
     def predict(self, inputs: np.ndarray, headers=None) -> np.ndarray:
         if self._jitted is not None:

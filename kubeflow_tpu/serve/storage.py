@@ -163,6 +163,10 @@ def download(
                 staged = _fetch_file(rest, staging)
             else:
                 fetcher = _FETCHERS.get(scheme)
+                if fetcher is None and scheme in ("http", "https", "s3", "gs"):
+                    from . import cloudstorage  # noqa: F401  (self-registers)
+
+                    fetcher = _FETCHERS.get(scheme)
                 if fetcher is None:
                     raise RuntimeError(
                         f"no fetcher registered for scheme '{scheme}://' "

@@ -362,16 +362,18 @@ def test_storage_file_and_stub_schemes(tmp_path):
 
     assert os.path.exists(os.path.join(dest, "w.bin"))
 
+    # gs/s3/http are REAL schemes now (serve/cloudstorage.py); only truly
+    # unknown schemes fall through to the registry error
     with pytest.raises(RuntimeError, match="no fetcher"):
-        storage_mod.download("gs://bucket/model", str(tmp_path / "mnt2"))
+        storage_mod.download("weird://bucket/model", str(tmp_path / "mnt2"))
 
     storage_mod.register_fetcher(
-        "gs", lambda uri, d: str((src / "w.bin"))
+        "weird", lambda uri, d: str((src / "w.bin"))
     )
-    assert storage_mod.download("gs://bucket/model", str(tmp_path / "m3")).endswith(
-        "w.bin"
-    )
-    storage_mod._FETCHERS.pop("gs")
+    assert storage_mod.download(
+        "weird://bucket/model", str(tmp_path / "m3")
+    ).endswith("w.bin")
+    storage_mod._FETCHERS.pop("weird")
 
 
 # --------------------------------------------------------------- controller

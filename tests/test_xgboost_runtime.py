@@ -1,0 +1,239 @@
+"""XGBoost-format runtime (serve/xgboost_runtime.py): the device
+fixed-depth traversal must match a straightforward host tree walk on
+checkpoints written in XGBoost's published JSON format — including NaN
+default routing, multiclass tree_info layout, and objective links.
+
+xgboost itself is NOT installed (SURVEY.md §0); checkpoints here are
+constructed in the documented ``save_model("*.json")`` schema, which is the
+same bytes a reference user's booster would bring.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serve.xgboost_runtime import (
+    XGBoostRuntimeModel,
+    build_device_predict,
+    margin_numpy,
+    parse_xgboost_json,
+)
+
+
+def _tree(split_indices, split_conditions, left, right, default_left):
+    n = len(left)
+    return {
+        "split_indices": split_indices,
+        "split_conditions": split_conditions,
+        "left_children": left,
+        "right_children": right,
+        "default_left": default_left,
+        "base_weights": [0.0] * n,
+        "tree_param": {"num_nodes": str(n)},
+    }
+
+
+def _checkpoint(
+    trees, tree_info=None, *, num_class=0, num_feature, base_score=0.5,
+    objective="reg:squarederror",
+):
+    return {
+        "version": [2, 0, 0],
+        "learner": {
+            "learner_model_param": {
+                "base_score": str(base_score),
+                "num_class": str(num_class),
+                "num_feature": str(num_feature),
+            },
+            "objective": {"name": objective},
+            "gradient_booster": {
+                "model": {
+                    "trees": trees,
+                    "tree_info": tree_info or [0] * len(trees),
+                }
+            },
+        },
+    }
+
+
+def _write(tmp_path, doc, name="model.json"):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+# node 0: x[0] < 0.5 ? node1(leaf +1) : node2(leaf -3); NaN goes left
+STUMP = _tree([0, 0, 0], [0.5, 1.0, -3.0], [1, -1, -1], [2, -1, -1],
+              [True, False, False])
+
+
+def test_single_stump_regression(tmp_path):
+    path = _write(tmp_path, _checkpoint([STUMP], num_feature=1, base_score=2.0))
+    b = parse_xgboost_json(path)
+    fwd = build_device_predict(b)
+    x = np.asarray([[0.0], [0.9], [np.nan]], np.float32)
+    # base_score is the margin intercept for squared error
+    np.testing.assert_allclose(
+        np.asarray(fwd(x)), [3.0, -1.0, 3.0], rtol=1e-6
+    )
+
+
+def test_depth_and_missing_routing_match_host_walk(tmp_path):
+    # deeper tree exercising both NaN directions
+    t = _tree(
+        [1, 0, 2, 0, 0, 0, 0],
+        [0.0, -1.0, 5.0, 0.25, -0.5, 1.5, -2.25],
+        [1, 3, 5, -1, -1, -1, -1],
+        [2, 4, 6, -1, -1, -1, -1],
+        [False, True, False, False, False, False, False],
+    )
+    path = _write(tmp_path, _checkpoint([t, STUMP], num_feature=3))
+    b = parse_xgboost_json(path)
+    fwd = build_device_predict(b, output="margin")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    x[rng.random(x.shape) < 0.25] = np.nan
+    np.testing.assert_allclose(
+        np.asarray(fwd(x))[:, 0], margin_numpy(b, x)[:, 0], rtol=1e-5
+    )
+
+
+def _random_checkpoint(rng, *, n_trees, num_feature, num_class=0,
+                       objective="reg:squarederror", base_score=0.5):
+    """Random well-formed trees: internal nodes in BFS order, ragged sizes."""
+    trees = []
+    for _ in range(n_trees):
+        n_internal = int(rng.integers(1, 8))
+        n = 2 * n_internal + 1
+        left = [-1] * n
+        right = [-1] * n
+        si = [0] * n
+        sc = [0.0] * n
+        dl = [False] * n
+        for i in range(n_internal):
+            left[i], right[i] = 2 * i + 1, 2 * i + 2
+            si[i] = int(rng.integers(0, num_feature))
+            sc[i] = float(rng.normal())
+            dl[i] = bool(rng.random() < 0.5)
+        for i in range(n_internal, n):
+            sc[i] = float(rng.normal())
+        trees.append(_tree(si, sc, left, right, dl))
+    info = (
+        [i % num_class for i in range(n_trees)] if num_class else None
+    )
+    return _checkpoint(
+        trees, info, num_class=num_class, num_feature=num_feature,
+        base_score=base_score, objective=objective,
+    )
+
+
+def test_fuzz_random_forests_match_host_walk(tmp_path):
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        doc = _random_checkpoint(rng, n_trees=11, num_feature=5)
+        b = parse_xgboost_json(_write(tmp_path, doc, f"m{trial}.json"))
+        x = rng.normal(size=(32, 5)).astype(np.float32)
+        x[rng.random(x.shape) < 0.2] = np.nan
+        got = np.asarray(build_device_predict(b, output="margin")(x))[:, 0]
+        np.testing.assert_allclose(got, margin_numpy(b, x)[:, 0], rtol=1e-4)
+
+
+def test_binary_logistic_outputs_probability(tmp_path):
+    path = _write(
+        tmp_path,
+        _checkpoint([STUMP], num_feature=1, base_score=0.5,
+                    objective="binary:logistic"),
+    )
+    b = parse_xgboost_json(path)
+    x = np.asarray([[0.0], [0.9]], np.float32)
+    prob = np.asarray(build_device_predict(b)(x))
+    # base_score 0.5 → margin intercept logit(0.5)=0; sigmoid(leaf sums)
+    np.testing.assert_allclose(
+        prob, 1.0 / (1.0 + np.exp(-np.asarray([1.0, -3.0]))), rtol=1e-5
+    )
+    assert ((prob > 0) & (prob < 1)).all()
+
+
+def test_multiclass_softmax_and_softprob(tmp_path):
+    rng = np.random.default_rng(3)
+    doc = _random_checkpoint(
+        rng, n_trees=9, num_feature=4, num_class=3, objective="multi:softprob"
+    )
+    b = parse_xgboost_json(_write(tmp_path, doc))
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    probs = np.asarray(build_device_predict(b)(x))
+    assert probs.shape == (16, 3)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+    margins = margin_numpy(b, x)
+    np.testing.assert_array_equal(
+        probs.argmax(-1), margins.argmax(-1)
+    )
+    # multi:softmax returns the class index directly
+    doc["learner"]["objective"]["name"] = "multi:softmax"
+    b2 = parse_xgboost_json(_write(tmp_path, doc, "m2.json"))
+    cls = np.asarray(build_device_predict(b2)(x))
+    np.testing.assert_array_equal(cls, margins.argmax(-1))
+
+
+def test_runtime_model_lifecycle_and_validation(tmp_path):
+    path = _write(tmp_path, _checkpoint([STUMP], num_feature=1))
+    m = XGBoostRuntimeModel("gbt", str(tmp_path))
+    m.load()
+    assert m.ready
+    out = m.postprocess(m.predict(m.preprocess({"instances": [[0.0]]})))
+    np.testing.assert_allclose(out["predictions"], [1.5])  # 1.0 + 0.5 base
+    with pytest.raises(ValueError, match="expects 1 features"):
+        m.preprocess([[1.0, 2.0]])
+    m.unload()
+    assert not m.ready
+
+
+def test_rejects_non_xgboost_json(tmp_path):
+    p = tmp_path / "model.json"
+    p.write_text(json.dumps({"not": "a booster"}))
+    with pytest.raises(RuntimeError, match="not an XGBoost JSON checkpoint"):
+        parse_xgboost_json(str(p))
+
+
+def test_e2e_through_model_server(tmp_path):
+    """xgboost format resolves from the default registry and answers REST."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.serve.runtimes import default_registry
+    from kubeflow_tpu.serve.server import ModelServer
+    from kubeflow_tpu.serve.spec import ComponentSpec
+
+    _write(tmp_path, _checkpoint([STUMP], num_feature=1))
+    rt = default_registry().resolve(
+        ComponentSpec(model_format="xgboost", storage_uri="unused")
+    )
+    model = rt.factory("gbt", str(tmp_path))
+    model.load()
+    server = ModelServer([model])
+
+    async def roundtrip():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post(
+                "/v1/models/gbt:predict", json={"instances": [[0.9], [0.0]]}
+            )
+            assert r.status == 200
+            return await r.json()
+
+    body = asyncio.run(roundtrip())
+    np.testing.assert_allclose(body["predictions"], [-2.5, 1.5])
+
+
+def test_categorical_splits_fail_closed(tmp_path):
+    """enable_categorical boosters store category sets, not thresholds —
+    serving them as numeric would be silently wrong. Must refuse to load."""
+    t = dict(STUMP)
+    t["split_type"] = [1, 0, 0]
+    t["categories"] = [2, 5]
+    path = _write(tmp_path, _checkpoint([t], num_feature=1))
+    with pytest.raises(RuntimeError, match="categorical"):
+        parse_xgboost_json(path)
