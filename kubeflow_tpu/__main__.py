@@ -1,0 +1,5 @@
+"""``python -m kubeflow_tpu`` → the ``kft`` CLI (see cli.py)."""
+
+from kubeflow_tpu.cli import main
+
+raise SystemExit(main())

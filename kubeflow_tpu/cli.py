@@ -1,0 +1,283 @@
+"""``kft`` — the unified command line for the framework.
+
+Reference analogs (SURVEY.md §2 — UNVERIFIED, mount empty, §0): ``kubectl
+apply -k`` + the training-operator kubectl plugin, the ``kfp`` CLI, and the
+KServe container entrypoint. One binary because the runtime is one process:
+the same manifests the Python SDKs accept are accepted here, so
+``kft run -f job.yaml`` is the CLI spelling of ``kubectl apply -f`` +
+``kubectl wait --for=condition=Succeeded``.
+
+Subcommands:
+
+- ``kft build <dir>``  — resolve a kustomize-style overlay to YAML
+  (delegates to `platform.manifests.build`; same output as its module CLI).
+- ``kft run -f <path>``— submit every Job/Experiment manifest in a file or
+  overlay dir to an in-process LocalCluster, wait for terminal conditions,
+  stream failure logs, exit 0 iff everything Succeeded.
+- ``kft serve -f <path>`` — materialise an InferenceService manifest:
+  storage-initialize the model, resolve its runtime from the default
+  registry, serve REST (+ optional gRPC) until SIGINT.
+- ``kft doctor``       — accelerator liveness via the subprocess probe
+  (never hangs on a wedged tunnel) + device inventory.
+- ``kft version``.
+
+Everything here is a thin veneer over public APIs — the CLI owns argument
+parsing and process lifecycle, nothing else.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _load_docs(path: str) -> list[dict]:
+    """A plain manifest file (possibly a multi-doc YAML stream), a
+    kustomization file, or an overlay dir — `kubectl apply -f|-k` in one."""
+    import yaml
+
+    from kubeflow_tpu.platform import manifests
+
+    if os.path.isdir(path):
+        return manifests.build(path)
+    with open(path) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    if any(
+        d.get("kind") == "Kustomization" or ("kind" not in d and "resources" in d)
+        for d in docs
+    ):
+        return manifests.build(path)
+    return docs
+
+
+def _cmd_build(args) -> int:
+    import yaml
+
+    yaml.safe_dump_all(_load_docs(args.path), sys.stdout, sort_keys=False)
+    return 0
+
+
+def _cmd_run(args) -> int:
+    from kubeflow_tpu.orchestrator.cluster import LocalCluster
+    from kubeflow_tpu.orchestrator.envwire import WiringConfig
+    from kubeflow_tpu.orchestrator.resources import Fleet
+    from kubeflow_tpu.orchestrator.spec import JobConditionType, JobSpec
+    from kubeflow_tpu.platform import manifests
+    from kubeflow_tpu.tune.spec import ExperimentSpec
+
+    jobs: list[JobSpec] = []
+    experiments: list[ExperimentSpec] = []
+    for doc in _load_docs(args.file):
+        try:
+            parsed = manifests.parse(doc)
+        except ValueError:
+            # kubectl semantics: apply what we know, note what we skip
+            print(
+                f"kft run: skipping unsupported kind "
+                f"{doc.get('kind')!r}",
+                file=sys.stderr,
+            )
+            continue
+        if isinstance(parsed, JobSpec):
+            jobs.append(parsed)
+        elif isinstance(parsed, ExperimentSpec):
+            experiments.append(parsed)
+        elif isinstance(parsed, dict):  # ConfigMap — nothing to run
+            continue
+        else:
+            print(
+                f"kft run: {doc.get('kind')!r} is not runnable here "
+                "(use `kft serve` for InferenceService)",
+                file=sys.stderr,
+            )
+            return 2
+    if not jobs and not experiments:
+        print("kft run: no runnable manifests found", file=sys.stderr)
+        return 2
+
+    fleet = Fleet.homogeneous(args.slices, args.topology)
+    wiring = WiringConfig(
+        platform=args.platform, devices_per_worker=args.devices_per_worker
+    )
+    failed = 0
+    with LocalCluster(fleet=fleet, wiring=wiring) as cluster:
+        uids = [(spec, cluster.submit(spec)) for spec in jobs]
+        deadline = time.monotonic() + args.timeout
+        for spec, uid in uids:
+            try:
+                status = cluster.wait(
+                    uid, timeout=max(0.01, deadline - time.monotonic())
+                )
+                phase = status.phase
+            except TimeoutError:
+                phase = "Timeout"
+            ok = phase == JobConditionType.SUCCEEDED.value
+            failed += 0 if ok else 1
+            print(f"job/{spec.name}: {phase}")
+            if args.logs or not ok:
+                for rtype, rspec in spec.replicas.items():
+                    for i in range(rspec.replicas):
+                        try:
+                            text = cluster.logs(uid, rtype, i)
+                        except (KeyError, OSError):
+                            continue
+                        for line in text.splitlines():
+                            print(f"  [{rtype}-{i}] {line}")
+        for exp in experiments:
+            from kubeflow_tpu.tune.controller import (
+                ExperimentController,
+                JobTrialRunner,
+            )
+
+            runner = JobTrialRunner(cluster, timeout_s=args.timeout)
+            status = ExperimentController(exp, runner).run()
+            best = status.optimal
+            ok = best is not None
+            failed += 0 if ok else 1
+            print(
+                f"experiment/{exp.name}: trials={len(status.trials)} "
+                f"best={best.metrics.get('__objective__') if best else None} "
+                f"assignment={dict(best.assignment.parameters) if best else {}}"
+            )
+    return 1 if failed else 0
+
+
+def _cmd_serve(args) -> int:
+    import asyncio
+
+    from kubeflow_tpu.platform import manifests
+    from kubeflow_tpu.serve import storage
+    from kubeflow_tpu.serve.runtimes import default_registry
+    from kubeflow_tpu.serve.server import ModelServer
+    from kubeflow_tpu.serve.spec import InferenceServiceSpec
+
+    specs = []
+    for doc in _load_docs(args.file):
+        try:
+            parsed = manifests.parse(doc)
+        except ValueError:
+            print(
+                f"kft serve: skipping unsupported kind {doc.get('kind')!r}",
+                file=sys.stderr,
+            )
+            continue
+        if isinstance(parsed, InferenceServiceSpec):
+            specs.append(parsed)
+    if not specs:
+        print("kft serve: no InferenceService manifests found", file=sys.stderr)
+        return 2
+
+    registry = default_registry()
+    model_dir = args.model_dir or tempfile.mkdtemp(prefix="kft-models-")
+    server = ModelServer(
+        http_port=args.http_port,
+        grpc_port=args.grpc_port,
+    )
+    for spec in specs:
+        spec.validate()
+        rt = registry.resolve(spec.predictor)
+        local = (
+            storage.download(spec.predictor.storage_uri, model_dir)
+            if spec.predictor.storage_uri
+            else None
+        )
+        model = rt.factory(spec.name, local)
+        server.register(model)
+        print(f"inferenceservice/{spec.name}: loaded ({rt.name})")
+
+    async def main() -> None:
+        await server.start_async()
+        # the bound port (http_port=0 → ephemeral) — for scripts/tests
+        sites = list(server._runner.sites) if server._runner else []
+        port = (
+            sites[0]._server.sockets[0].getsockname()[1]  # noqa: SLF001
+            if sites
+            else args.http_port
+        )
+        print(f"serving on http://127.0.0.1:{port}", flush=True)
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(str(port))
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await server.stop_async()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_doctor(args) -> int:
+    from kubeflow_tpu.core.deviceprobe import UNREACHABLE, probe_backend
+
+    backend = probe_backend(timeout_s=args.timeout)
+    report: dict = {"backend": backend, "reachable": backend != UNREACHABLE}
+    if backend != UNREACHABLE:
+        # safe to touch jax in-process once the subprocess probe passed
+        import jax
+
+        report["devices"] = jax.device_count()
+        report["device_kind"] = jax.devices()[0].device_kind
+    print(json.dumps(report))
+    return 0 if report["reachable"] else 1
+
+
+def _cmd_version(_args) -> int:
+    import kubeflow_tpu
+
+    print(getattr(kubeflow_tpu, "__version__", "0.dev"))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="kft", description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("build", help="resolve a kustomize overlay to YAML")
+    b.add_argument("path")
+    b.set_defaults(fn=_cmd_build)
+
+    r = sub.add_parser("run", help="run Job/Experiment manifests to completion")
+    r.add_argument("-f", "--file", required=True,
+                   help="manifest file or overlay dir")
+    r.add_argument("--timeout", type=float, default=300.0)
+    r.add_argument("--logs", action="store_true",
+                   help="print worker logs even on success")
+    r.add_argument("--slices", type=int, default=1)
+    r.add_argument("--topology", default="2x2")
+    r.add_argument("--platform", default="cpu_sim",
+                   choices=("cpu_sim", "tpu"))
+    r.add_argument("--devices-per-worker", type=int, default=1)
+    r.set_defaults(fn=_cmd_run)
+
+    s = sub.add_parser("serve", help="serve InferenceService manifests")
+    s.add_argument("-f", "--file", required=True)
+    s.add_argument("--http-port", type=int, default=8080)
+    s.add_argument("--grpc-port", type=int, default=None)
+    s.add_argument("--model-dir", default=None,
+                   help="storage-initializer destination (default: tmpdir)")
+    s.add_argument("--port-file", default=None,
+                   help="write the bound HTTP port here once listening")
+    s.set_defaults(fn=_cmd_serve)
+
+    d = sub.add_parser("doctor", help="accelerator liveness + inventory")
+    d.add_argument("--timeout", type=float, default=120.0)
+    d.set_defaults(fn=_cmd_doctor)
+
+    v = sub.add_parser("version")
+    v.set_defaults(fn=_cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -99,7 +99,28 @@ def http_get_to_file(
             sign("GET", url, hdrs)
         req = urllib.request.Request(url, headers=hdrs)  # noqa: S310
         try:
-            with _open(req, timeout) as resp:
+            try:
+                resp_cm = _open(req, timeout)
+            except PermanentError as e:
+                # 416 on a RESUME means our offset >= the object's size —
+                # i.e. the previous attempt already delivered every byte
+                # (common when a chunked response died before its terminal
+                # chunk). Complete if sizes agree; restart if we overshot.
+                cause = e.__cause__
+                if (
+                    have > 0
+                    and isinstance(cause, urllib.error.HTTPError)
+                    and cause.code == 416
+                ):
+                    total = (cause.headers.get("Content-Range") or "").rpartition(
+                        "/"
+                    )[2]
+                    if not total.isdigit() or int(total) == have:
+                        return dest_path
+                    os.remove(dest_path)  # etag/size changed: start over
+                    continue
+                raise
+            with resp_cm as resp:
                 if have > 0 and resp.status == 200:
                     have = 0  # server ignored Range: restart from scratch
                 etag = resp.headers.get("ETag") or etag
@@ -267,6 +288,42 @@ def _s3_list(endpoint: str, bucket: str, prefix: str, sign) -> list[tuple[str, i
             return keys
 
 
+def _download_listing(
+    staging: str,
+    prefix: str,
+    names: list[str],
+    url_fn,
+    *,
+    fallback_root: str,
+    what: str,
+    sign=None,
+    headers: dict[str, str] | None = None,
+) -> str:
+    """Shared exact-key / directory-prefix materialisation for object
+    stores. An exact key downloads as one file; otherwise only keys UNDER
+    ``prefix/`` count — a sibling like ``bert-old/...`` merely
+    string-prefix-matching ``bert`` must never be flattened into the
+    artifact (it would silently serve the wrong weights)."""
+    if prefix in names:
+        base_name = os.path.basename(prefix) or "model"
+        return http_get_to_file(
+            url_fn(prefix), os.path.join(staging, base_name),
+            sign=sign, headers=headers,
+        )
+    base = prefix if prefix.endswith("/") or not prefix else prefix + "/"
+    under = [n for n in names if n.startswith(base)]
+    if not under:
+        raise PermanentError(f"{what}: no such key or prefix")
+    root = os.path.join(
+        staging, os.path.basename(prefix.rstrip("/")) or fallback_root
+    )
+    for name in under:
+        local = os.path.join(root, name[len(base):])
+        os.makedirs(os.path.dirname(local), exist_ok=True)
+        http_get_to_file(url_fn(name), local, sign=sign, headers=headers)
+    return root
+
+
 def _fetch_s3(uri: str, staging: str) -> str:
     p = urllib.parse.urlparse(uri)
     bucket, prefix = p.netloc, p.path.lstrip("/")
@@ -277,24 +334,10 @@ def _fetch_s3(uri: str, staging: str) -> str:
         return f"{endpoint}/{bucket}/{urllib.parse.quote(key)}"
 
     keys = _s3_list(endpoint, bucket, prefix, sign)
-    exact = [k for k, _ in keys if k == prefix]
-    if exact:
-        name = os.path.basename(prefix) or "model"
-        return http_get_to_file(
-            obj_url(prefix), os.path.join(staging, name), sign=sign
-        )
-    if not keys:
-        raise PermanentError(f"s3://{bucket}/{prefix}: no such key or prefix")
-    root = os.path.join(
-        staging, os.path.basename(prefix.rstrip("/")) or bucket
+    return _download_listing(
+        staging, prefix, [k for k, _ in keys], obj_url,
+        fallback_root=bucket, what=f"s3://{bucket}/{prefix}", sign=sign,
     )
-    base = prefix if prefix.endswith("/") or not prefix else prefix + "/"
-    for key, _ in keys:
-        rel = key[len(base):] if key.startswith(base) else os.path.basename(key)
-        local = os.path.join(root, rel)
-        os.makedirs(os.path.dirname(local), exist_ok=True)
-        http_get_to_file(obj_url(key), local, sign=sign)
-    return root
 
 
 # --------------------------------------------------------------------------- #
@@ -350,25 +393,11 @@ def _fetch_gs(uri: str, staging: str) -> str:
         )
 
     names = _gs_list(endpoint, bucket, prefix)
-    if prefix in names:
-        base_name = os.path.basename(prefix) or "model"
-        return http_get_to_file(
-            media_url(prefix),
-            os.path.join(staging, base_name),
-            headers=_gs_headers(),
-        )
-    if not names:
-        raise PermanentError(f"gs://{bucket}/{prefix}: no such object or prefix")
-    root = os.path.join(
-        staging, os.path.basename(prefix.rstrip("/")) or bucket
+    return _download_listing(
+        staging, prefix, names, media_url,
+        fallback_root=bucket, what=f"gs://{bucket}/{prefix}",
+        headers=_gs_headers(),
     )
-    base = prefix if prefix.endswith("/") or not prefix else prefix + "/"
-    for name in names:
-        rel = name[len(base):] if name.startswith(base) else os.path.basename(name)
-        local = os.path.join(root, rel)
-        os.makedirs(os.path.dirname(local), exist_ok=True)
-        http_get_to_file(media_url(name), local, headers=_gs_headers())
-    return root
 
 
 def register_all() -> None:

@@ -308,7 +308,17 @@ class XGBoostRuntimeModel(Model):
         return arr
 
     def predict(self, inputs: np.ndarray, headers=None) -> np.ndarray:
-        return np.asarray(self._jitted(inputs))
+        # bucket the batch to the next power of two so varying request
+        # sizes hit a bounded set of compiled shapes (log2 many), never a
+        # per-size retrace on the request path. Pad rows are all-zero and
+        # sliced away (same discipline as BertRuntimeModel's buckets).
+        n = inputs.shape[0]
+        bucket = 1 << (n - 1).bit_length() if n > 1 else 1
+        if bucket != n:
+            inputs = np.concatenate(
+                [inputs, np.zeros((bucket - n, inputs.shape[1]), inputs.dtype)]
+            )
+        return np.asarray(self._jitted(inputs))[:n]
 
     def postprocess(self, outputs: np.ndarray, headers=None) -> Any:
         return {"predictions": outputs.tolist()}

@@ -206,6 +206,23 @@ class ExperimentSpec:
             "parallel_trial_count": self.parallel_trial_count,
             "max_trial_count": self.max_trial_count,
             "max_failed_trial_count": self.max_failed_trial_count,
+            **(
+                {"trial_template": self.trial_template}
+                if self.trial_template is not None
+                else {}
+            ),
+            **(
+                {
+                    "early_stopping": {
+                        "name": self.early_stopping.name,
+                        "min_trials_required":
+                            self.early_stopping.min_trials_required,
+                        "start_step": self.early_stopping.start_step,
+                    }
+                }
+                if self.early_stopping is not None
+                else {}
+            ),
         }
 
     @classmethod
@@ -227,6 +244,18 @@ class ExperimentSpec:
             parallel_trial_count=int(d.get("parallel_trial_count", 3)),
             max_trial_count=int(d.get("max_trial_count", 12)),
             max_failed_trial_count=int(d.get("max_failed_trial_count", 3)),
+            # without these, a manifest-borne Experiment would silently lose
+            # its trial command — the one thing that makes it runnable
+            trial_template=d.get("trial_template"),
+            early_stopping=(
+                EarlyStoppingSpec(
+                    name=es.get("name", "medianstop"),
+                    min_trials_required=int(es.get("min_trials_required", 3)),
+                    start_step=int(es.get("start_step", 4)),
+                )
+                if (es := d.get("early_stopping")) is not None
+                else None
+            ),
         )
 
 

@@ -414,3 +414,65 @@ def test_sigv4_key_with_space_single_encoding(tmp_path, monkeypatch):
         )
     assert open(dest, "rb").read() == objects["zoo/my model.bin"]
     assert all(a and "Signature=" in a for a in seen["auth"])
+
+
+def test_s3_prefix_does_not_leak_sibling_keys(tmp_path, monkeypatch):
+    """'bert-old/...' string-prefix-matches 'bert' in the listing but is NOT
+    under 'bert/' — it must be excluded, never basename-flattened in."""
+    objects = {
+        "bert/weights.bin": b"GOOD" * 1000,
+        "bert-old/weights.bin": b"STALE" * 1000,
+        "bert/config.json": b"{}",
+    }
+    seen: dict = {}
+    with _Server(_s3_app(objects, seen)) as srv:
+        monkeypatch.setenv("AWS_ENDPOINT_URL", f"http://127.0.0.1:{srv.port}")
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+        dest = storage.download("s3://models/bert", str(tmp_path / "mnt"))
+    import os
+
+    assert sorted(
+        os.path.relpath(os.path.join(r, f), dest)
+        for r, _, fs in os.walk(dest)
+        for f in fs
+    ) == ["config.json", "weights.bin"]
+    assert open(os.path.join(dest, "weights.bin"), "rb").read() == objects[
+        "bert/weights.bin"
+    ]
+
+
+def test_resume_at_eof_416_completes(tmp_path):
+    """Chunked body fully delivered but connection died before the terminal
+    chunk: the resume offset == file size, a real server answers 416, and
+    the download must COMPLETE (the bytes are all here), not abort."""
+    data = b"Z" * 200_000
+    state = {"calls": 0}
+
+    async def get(request):
+        state["calls"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            start = int(rng[len("bytes="):].rstrip("-").split("-")[0])
+            if start >= len(data):
+                raise web.HTTPRequestRangeNotSatisfiable(
+                    headers={"Content-Range": f"bytes */{len(data)}"}
+                )
+            return _range_body(request, data)
+        resp = web.StreamResponse(status=200)  # chunked, no Content-Length
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        await resp.write(data)
+        import asyncio as aio
+
+        await aio.sleep(0.2)
+        request.transport.close()  # die before the terminal chunk
+        return resp
+
+    app = web.Application()
+    app.router.add_get("/z.bin", get)
+    with _Server(app) as srv:
+        dest = storage.download(
+            f"http://127.0.0.1:{srv.port}/z.bin", str(tmp_path / "mnt")
+        )
+    assert open(dest, "rb").read() == data
+    assert state["calls"] >= 2  # the 416 resume round-trip happened

@@ -237,3 +237,18 @@ def test_categorical_splits_fail_closed(tmp_path):
     path = _write(tmp_path, _checkpoint([t], num_feature=1))
     with pytest.raises(RuntimeError, match="categorical"):
         parse_xgboost_json(path)
+
+
+def test_predict_buckets_batch_sizes(tmp_path):
+    """Odd batch sizes pad to the next power-of-two compiled shape and
+    slice back — answers identical to the exact-shape run."""
+    rng = np.random.default_rng(5)
+    doc = _random_checkpoint(rng, n_trees=5, num_feature=3)
+    _write(tmp_path, doc)
+    m = XGBoostRuntimeModel("gbt", str(tmp_path))
+    m.load()
+    x = rng.normal(size=(7, 3)).astype(np.float32)
+    out = m.predict(x)
+    assert out.shape[0] == 7
+    np.testing.assert_allclose(out, margin_numpy(m.booster, x)[:, 0]
+                               + 0.0, rtol=1e-4)
