@@ -181,22 +181,41 @@ class Attention(nn.Module):
             # cache — (B, H, max_len, D) — threaded through apply(), never
             # flax mutable state. Already-roped keys are cached, so decode
             # steps pay one GEMV against the cache, not a re-prefill.
-            K = jax.lax.dynamic_update_slice(
-                layer_cache["k"], k.astype(layer_cache["k"].dtype),
-                (0, 0, cache_index, 0),
-            )
-            V = jax.lax.dynamic_update_slice(
-                layer_cache["v"], v.astype(layer_cache["v"].dtype),
-                (0, 0, cache_index, 0),
-            )
+            if getattr(cache_index, "ndim", 0) == 1:
+                # PER-ROW slots (B,): continuous batching writes each row at
+                # its own progress point (rows admitted at different times)
+                upd = lambda c, new, i: jax.lax.dynamic_update_slice(
+                    c, new, (0, i, 0)
+                )
+                K = jax.vmap(upd)(
+                    layer_cache["k"], k.astype(layer_cache["k"].dtype),
+                    cache_index,
+                )
+                V = jax.vmap(upd)(
+                    layer_cache["v"], v.astype(layer_cache["v"].dtype),
+                    cache_index,
+                )
+            else:
+                K = jax.lax.dynamic_update_slice(
+                    layer_cache["k"], k.astype(layer_cache["k"].dtype),
+                    (0, 0, cache_index, 0),
+                )
+                V = jax.lax.dynamic_update_slice(
+                    layer_cache["v"], v.astype(layer_cache["v"].dtype),
+                    (0, 0, cache_index, 0),
+                )
             new_cache = {"k": K, "v": V}
             T = K.shape[2]
             kpos = jnp.arange(T)
             if kv_mask is None:
                 # default: plain causal over absolute slots (prefill)
-                qpos = cache_index + jnp.arange(S)
-                mask = (kpos[None, :] <= qpos[:, None])[None, :, :]  # (1,S,T)
-                mask = jnp.broadcast_to(mask, (B, S, T))
+                if getattr(cache_index, "ndim", 0) == 1:
+                    qpos = cache_index[:, None] + jnp.arange(S)[None, :]
+                    mask = kpos[None, None, :] <= qpos[:, :, None]  # (B,S,T)
+                else:
+                    qpos = cache_index + jnp.arange(S)
+                    mask = (kpos[None, :] <= qpos[:, None])[None, :, :]
+                    mask = jnp.broadcast_to(mask, (B, S, T))
             else:
                 mask = jnp.broadcast_to(kv_mask[:, None, :], (B, S, T))
             scale = 1.0 / jnp.sqrt(jnp.float32(D))

@@ -66,14 +66,18 @@ def _attn_kernel(
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)  # (Bq, D)
-        k = k_ref[0, 0].astype(jnp.float32)  # (Bk, D)
-        v = v_ref[0, 0].astype(jnp.float32)  # (Bk, D)
+        # MXU operands stay in the INPUT dtype (bf16 on TPU: full-rate MXU
+        # passes; fp32 operands would run it 4-8x slower) — accumulation is
+        # f32 via preferred_element_type, and bf16→f32 is exact, so QKᵀ is
+        # bit-identical to an upcast-first fp32 matmul. Softmax math is f32.
+        q = q_ref[0, 0]  # (Bq, D)
+        k = k_ref[0, 0]  # (Bk, D)
+        v = v_ref[0, 0]  # (Bk, D)
         s = jax.lax.dot_general(
             q, k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (Bq, Bk)
+        ) * scale  # (Bq, Bk) f32
 
         mask = _tile_mask(
             iq, ik, causal=causal, block_q=block_q, block_k=block_k,
@@ -240,10 +244,13 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)    # (Bq, D)
-        k = k_ref[0, 0].astype(jnp.float32)    # (Bk, D)
-        v = v_ref[0, 0].astype(jnp.float32)    # (Bk, D)
-        do = do_ref[0, 0].astype(jnp.float32)  # (Bq, D)
+        # native-dtype MXU operands, f32 accumulate (see fwd kernel note);
+        # ds is cast back to the input dtype for its matmuls — the standard
+        # flash-bwd mixed-precision contract
+        q = q_ref[0, 0]        # (Bq, D)
+        k = k_ref[0, 0]        # (Bk, D)
+        v = v_ref[0, 0]        # (Bk, D)
+        do = do_ref[0, 0]      # (Bq, D)
         lse = lse_ref[0, 0]                    # (Bq, 1)
         delta = delta_ref[0, 0]                # (Bq, 1)
         mask = _tile_mask(
@@ -255,8 +262,8 @@ def _bwd_dq_kernel(
             do, v,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (Bq, Bk)
-        ds = p * (dp - delta) * scale
+        )  # (Bq, Bk) f32
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(ik == num_k_blocks - 1)
@@ -288,10 +295,11 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)    # (Bq, D)
-        k = k_ref[0, 0].astype(jnp.float32)    # (Bk, D)
-        v = v_ref[0, 0].astype(jnp.float32)    # (Bk, D)
-        do = do_ref[0, 0].astype(jnp.float32)  # (Bq, D)
+        # native-dtype MXU operands, f32 accumulate (see fwd kernel note)
+        q = q_ref[0, 0]        # (Bq, D)
+        k = k_ref[0, 0]        # (Bk, D)
+        v = v_ref[0, 0]        # (Bk, D)
+        do = do_ref[0, 0]      # (Bq, D)
         lse = lse_ref[0, 0]                    # (Bq, 1)
         delta = delta_ref[0, 0]                # (Bq, 1)
         mask = _tile_mask(
@@ -301,7 +309,7 @@ def _bwd_dkv_kernel(
         p = _prob_block(q, k, lse, mask, scale=scale)
         # dv += pᵀ · do
         dv_acc[:] += jax.lax.dot_general(
-            p, do,
+            p.astype(do.dtype), do,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -309,8 +317,8 @@ def _bwd_dkv_kernel(
             do, v,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (Bq, Bk)
-        ds = p * (dp - delta) * scale
+        )  # (Bq, Bk) f32
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         # dk += dsᵀ · q
         dk_acc[:] += jax.lax.dot_general(
             ds, q,

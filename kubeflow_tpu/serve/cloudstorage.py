@@ -117,7 +117,11 @@ def http_get_to_file(
                     )[2]
                     if not total.isdigit() or int(total) == have:
                         return dest_path
-                    os.remove(dest_path)  # etag/size changed: start over
+                    # object changed size under us: start over CLEAN — the
+                    # stale expected/etag belong to the previous version and
+                    # would fail the fresh download's own checks
+                    os.remove(dest_path)
+                    expected = etag = None
                     continue
                 raise
             with resp_cm as resp:

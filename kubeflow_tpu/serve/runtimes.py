@@ -233,6 +233,18 @@ def default_registry() -> RuntimeRegistry:
             priority=1,
         )
     )
+    # continuous batching (the vLLM-backend analog): concurrent requests
+    # share one running decode batch — same data path, engine underneath
+    from kubeflow_tpu.serve.engine import LMEngineModel
+
+    reg.register(
+        ServingRuntime(
+            name="kubeflow-tpu-causal-lm-engine",
+            supported_formats=("causal-lm-engine", "vllm"),
+            factory=LMEngineModel,
+            priority=1,
+        )
+    )
     reg.register(
         ServingRuntime(
             name="kubeflow-tpu-sklearn",
