@@ -55,9 +55,25 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     tokens: list[int] = field(default_factory=list)
     error: Exception | None = None
+    # streaming consumers get every appended token incrementally; None for
+    # plain submit() (no queue churn on the non-streaming path)
+    live: "queue.Queue[list[int] | None] | None" = None
+    # consumer walked away (client disconnect): free the row at the next
+    # chunk boundary instead of decoding tokens nobody reads
+    cancelled: threading.Event = field(default_factory=threading.Event)
     # set on admission:
     row: int = -1
     gen_start: int = 0
+
+    def push(self, toks: list[int]) -> None:
+        self.tokens.extend(toks)
+        if self.live is not None and toks:
+            self.live.put(list(toks))
+
+    def finish(self) -> None:
+        if self.live is not None:
+            self.live.put(None)  # stream sentinel
+        self.done.set()
 
 
 class LMEngine:
@@ -214,6 +230,52 @@ class LMEngine:
         self._work.set()
         if self._thread is not None:
             self._thread.join(30)
+        # anything still queued or mid-generation must not hang its caller
+        # until timeout_s — fail it with the truth now
+        err = RuntimeError("LM engine stopped")
+        for row in range(self.max_batch):
+            req = self._slots[row]
+            if req is not None:
+                self._slots[row] = None
+                req.error = err
+                req.finish()
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            req.error = err
+            req.finish()
+
+    def _enqueue(
+        self, ids, max_new_tokens, temperature, *, live: bool
+    ) -> _Request:
+        if not ids:
+            raise ValueError("empty prompt")
+        if self._fatal is not None:
+            raise RuntimeError("LM engine is dead") from self._fatal
+        if self._stop.is_set():
+            # a submit racing (or following) stop() must fail NOW — the
+            # scheduler thread is gone and nothing would ever service it
+            raise RuntimeError("LM engine stopped")
+        bucket = self._bucket(len(ids))
+        if bucket + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt bucket {bucket} + max_new_tokens {max_new_tokens} "
+                f"exceeds engine max_seq {self.max_seq}"
+            )
+        req = _Request(
+            list(ids), max_new_tokens, temperature,
+            live=queue.Queue() if live else None,
+        )
+        self._pending.put(req)
+        self._work.set()
+        if self._stop.is_set() and not req.done.is_set():
+            # raced stop()'s drain: fail it ourselves (double-finish from
+            # the drain is harmless — same error, idempotent events)
+            req.error = RuntimeError("LM engine stopped")
+            req.finish()
+        return req
 
     def submit(
         self,
@@ -223,24 +285,40 @@ class LMEngine:
         temperature: float = 0.0,
         timeout_s: float = 300.0,
     ) -> list[int]:
-        if not ids:
-            raise ValueError("empty prompt")
-        if self._fatal is not None:
-            raise RuntimeError("LM engine is dead") from self._fatal
-        bucket = self._bucket(len(ids))
-        if bucket + max_new_tokens > self.max_seq:
-            raise ValueError(
-                f"prompt bucket {bucket} + max_new_tokens {max_new_tokens} "
-                f"exceeds engine max_seq {self.max_seq}"
-            )
-        req = _Request(list(ids), max_new_tokens, temperature)
-        self._pending.put(req)
-        self._work.set()
+        req = self._enqueue(ids, max_new_tokens, temperature, live=False)
         if not req.done.wait(timeout_s):
             raise TimeoutError("generation timed out")
         if req.error is not None:
             raise req.error
         return req.tokens
+
+    def stream(
+        self,
+        ids: list[int],
+        *,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        timeout_s: float = 300.0,
+    ):
+        """Yields lists of new tokens as decode chunks complete — the
+        streaming data path (KServe v2 generate_stream analog)."""
+        req = self._enqueue(ids, max_new_tokens, temperature, live=True)
+        try:
+            while True:
+                try:
+                    item = req.live.get(timeout=timeout_s)
+                except queue.Empty:
+                    raise TimeoutError("generation timed out") from None
+                if item is None:
+                    break
+                yield item
+            if req.error is not None:
+                raise req.error
+        finally:
+            # generator closed early (client disconnect) → release the row
+            if not req.done.is_set():
+                req.cancelled.set()
+                self._work.set()
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -252,6 +330,12 @@ class LMEngine:
         )
 
     def _admit_all(self) -> None:
+        # cancelled mid-generation rows free up before admission looks for
+        # space — a disconnected client must not hold a row
+        for row in range(self.max_batch):
+            req = self._slots[row]
+            if req is not None and req.cancelled.is_set():
+                self._finish(row)
         while True:
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
@@ -260,12 +344,15 @@ class LMEngine:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 return
+            if req.cancelled.is_set():
+                req.finish()  # consumer already gone: never admit
+                continue
             row = free[0]
             try:
                 self._admit(req, row)
             except Exception as e:  # bad request: fail it, keep serving
                 req.error = e
-                req.done.set()
+                req.finish()
 
     def _admit(self, req: _Request, row: int) -> None:
         bucket = self._bucket(len(req.ids))
@@ -289,7 +376,7 @@ class LMEngine:
         self.budget[row] = req.max_new_tokens
         self.temp[row] = req.temperature
         if bool(valid):
-            req.tokens.append(tok)
+            req.push([tok])
         self.last_tok[row] = tok
         # one-token completions (eos first, or budget 1) finish here
         finished = (not bool(valid)) or req.max_new_tokens <= 1
@@ -308,7 +395,7 @@ class LMEngine:
         self._slots[row] = None
         self.active[row] = False
         if req is not None:
-            req.done.set()
+            req.finish()
             self.stats["completed"] += 1
 
     def _loop(self) -> None:
@@ -324,14 +411,14 @@ class LMEngine:
                 if req is not None:
                     req.error = e
                     self._slots[row] = None
-                    req.done.set()
+                    req.finish()
             while True:
                 try:
                     req = self._pending.get_nowait()
                 except queue.Empty:
                     break
                 req.error = e
-                req.done.set()
+                req.finish()
 
     def _loop_inner(self) -> None:
         while not self._stop.is_set():
@@ -368,13 +455,15 @@ class LMEngine:
                 if req is None or not self.active[row]:
                     continue
                 hit_eos = False
+                fresh: list[int] = []
                 for j in range(self.chunk_steps):
-                    if len(req.tokens) >= req.max_new_tokens:
+                    if len(req.tokens) + len(fresh) >= req.max_new_tokens:
                         break
                     if not valid[row, j]:
                         hit_eos = True
                         break
-                    req.tokens.append(int(toks[row, j]))
+                    fresh.append(int(toks[row, j]))
+                req.push(fresh)
                 self.active[row] = bool(device_active[row])
                 if hit_eos or len(req.tokens) >= req.max_new_tokens:
                     self._finish(row)
@@ -456,6 +545,15 @@ class LMEngineModel(LMRuntimeModel):
         # sync path (gRPC, batcher): fan rows out so they share the decode
         # batch with each other and with everyone else's requests
         return list(self._executor.map(self._submit_row, rows))
+
+    def stream_row_tokens(self, row):
+        """Blocking generator of token-chunks for one preprocessed row —
+        the server's generate_stream (SSE) hook."""
+        yield from self.engine.stream(
+            row["ids"],
+            max_new_tokens=self.max_new_tokens,
+            temperature=row["temperature"],
+        )
 
     async def __call__(self, payload, headers=None):
         import asyncio

@@ -174,7 +174,130 @@ class ModelServer:
         app.router.add_get("/v2/health/ready", self._v2_ready)
         app.router.add_get("/v2/models/{name}", self._v2_meta)
         app.router.add_post("/v2/models/{name}/infer", self._v2_infer)
+        # text-generation extension (KServe v2 generate protocol analog):
+        # answered by engine-backed models; 501 elsewhere
+        app.router.add_post("/v2/models/{name}/generate", self._v2_generate)
+        app.router.add_post(
+            "/v2/models/{name}/generate_stream", self._v2_generate_stream
+        )
         return app
+
+    async def _v2_generate(self, req: web.Request) -> web.Response:
+        name = req.match_info["name"]
+        model = self.dataplane.get(name)
+        if getattr(model, "stream_row_tokens", None) is None:
+            raise web.HTTPNotImplemented(
+                reason=f"model '{name}' is not a generative engine runtime"
+            )
+        try:
+            body = await req.json()
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        try:
+            result = await self.dataplane.infer(
+                name, {"instances": [body]}, dict(req.headers)
+            )
+        except ValueError as e:  # same 400 contract as /infer and :predict
+            raise web.HTTPBadRequest(reason=str(e))
+        return web.json_response(result["predictions"][0])
+
+    async def _v2_generate_stream(self, req: web.Request) -> web.StreamResponse:
+        """Server-sent events: one ``data:`` frame per decode chunk as the
+        engine produces it, then a terminal ``done`` frame."""
+        import json
+        import threading
+
+        name = req.match_info["name"]
+        model = self.dataplane.get(name)
+        stream_rows = getattr(model, "stream_row_tokens", None)
+        if stream_rows is None:
+            raise web.HTTPNotImplemented(
+                reason=f"model '{name}' does not support streaming "
+                "(causal-lm-engine runtimes do)"
+            )
+        try:
+            body = await req.json()
+            row = model.preprocess({"instances": [body]})[0]
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        # streamed requests ride the same accounting as the DataPlane hot
+        # path — /metrics and the audit log must see them
+        req_id = req.headers.get("x-request-id", str(uuid.uuid4()))
+        if self.dataplane.logger is not None:
+            self.dataplane.logger.log_request(
+                name, req_id, {"instances": [body]}
+            )
+        t0 = time.perf_counter()
+
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(req)
+        loop = asyncio.get_running_loop()
+        frames: asyncio.Queue = asyncio.Queue()
+        disconnected = threading.Event()
+
+        def pump() -> None:
+            gen = stream_rows(row)
+
+            def emit(item) -> None:
+                try:
+                    loop.call_soon_threadsafe(frames.put_nowait, item)
+                except RuntimeError:  # loop closed (server shutdown)
+                    disconnected.set()
+
+            try:
+                for toks in gen:
+                    if disconnected.is_set():
+                        break
+                    emit(("tokens", toks))
+                emit(("done", None))
+            except Exception as e:  # noqa: BLE001 — surfaced as an SSE frame
+                emit(("error", e))
+            finally:
+                # closing the generator cancels the engine row, so a
+                # disconnected client stops consuming decode capacity
+                gen.close()
+
+        threading.Thread(
+            target=pump, name=f"sse-{name}", daemon=True
+        ).start()
+        total = 0
+        streamed: list[int] = []
+        try:
+            while True:
+                kind, val = await frames.get()
+                if kind == "tokens":
+                    toks = [int(t) for t in val]
+                    total += len(toks)
+                    streamed.extend(toks)
+                    payload = {"token_ids": toks}
+                elif kind == "done":
+                    payload = {"done": True, "n_tokens": total}
+                else:
+                    payload = {"error": str(val)}
+                await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+                if kind != "tokens":
+                    break
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            disconnected.set()  # pump stops; generator close frees the row
+            raise
+        finally:
+            dt = (time.perf_counter() - t0) * 1e3
+            m = self.dataplane.metrics
+            m["requests_total"][name] = m["requests_total"].get(name, 0) + 1
+            m["latency_ms"].setdefault(name, deque(maxlen=4096)).append(dt)
+            if self.dataplane.logger is not None:
+                self.dataplane.logger.log_response(
+                    name, req_id,
+                    {"predictions": [{"token_ids": streamed}],
+                     "streamed": True, "complete": not disconnected.is_set()},
+                )
+        return resp
 
     async def _v1_status(self, req: web.Request) -> web.Response:
         m = self.dataplane.get(req.match_info["name"])

@@ -9,6 +9,14 @@ one process. MUST run before the first ``import jax`` anywhere.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Scrub the accelerator-plugin trigger for this process AND everything it
+# spawns (CLI serve subprocesses, e2e gangs): the image's sitecustomize
+# registers the tunneled-TPU plugin whenever PALLAS_AXON_POOL_IPS is set,
+# and when the tunnel wedges that registration BLOCKS at interpreter
+# startup even under JAX_PLATFORMS=cpu. The CPU suite must never depend
+# on tunnel health. (envwire.py does the same for launcher children.)
+for _k in [k for k in os.environ if k.startswith("PALLAS_AXON")]:
+    os.environ.pop(_k)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -173,7 +173,9 @@ def test_serve_subprocess_answers_rest(tmp_path):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     try:
-        deadline = time.time() + 60
+        # generous: under a full parallel suite on a 1-cpu host, the
+        # subprocess's jax import + model load alone can take >60s
+        deadline = time.time() + 240
         while not port_file.exists() and time.time() < deadline:
             assert proc.poll() is None, proc.stdout.read()
             time.sleep(0.1)

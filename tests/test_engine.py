@@ -278,3 +278,171 @@ def test_chunk_failure_fails_requests_not_hangs(model_and_params):
             eng.submit([3, 4, 5], max_new_tokens=8, timeout_s=30)
     finally:
         eng.stop()
+
+
+def test_generate_stream_sse(model_and_params):
+    """generate_stream must deliver tokens INCREMENTALLY (multiple SSE
+    frames, chunk-sized), and their concatenation equals the reference
+    completion; /generate returns the same thing at once."""
+    import asyncio
+    import json as jsonlib
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.serve.engine import LMEngineModel
+    from kubeflow_tpu.serve.model import BucketSpec
+    from kubeflow_tpu.serve.server import ModelServer
+
+    model, params = model_and_params
+    m = LMEngineModel(
+        "lm", None, config=CFG, max_batch=2, chunk_steps=2,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+        max_new_tokens=12, eos_id=EOS,
+    )
+    m.load()
+    m._params = jax.device_put(params)
+    m.engine.stop()
+    m.engine = LMEngine(
+        m._model, CFG, params, max_batch=2, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    server = ModelServer([m])
+    ids = [7, 11, 13, 17, 19]
+    want = _reference_completion(model, params, ids, 12)
+
+    async def drive():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post(
+                "/v2/models/lm/generate_stream", json={"input_ids": ids}
+            )
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            frames = []
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: "):
+                    frames.append(jsonlib.loads(line[len("data: "):]))
+            r2 = await client.post(
+                "/v2/models/lm/generate", json={"input_ids": ids}
+            )
+            assert r2.status == 200
+            return frames, await r2.json()
+
+    try:
+        frames, whole = asyncio.run(drive())
+    finally:
+        m.unload()
+    token_frames = [f for f in frames if "token_ids" in f]
+    got = [t for f in token_frames for t in f["token_ids"]]
+    assert got == want
+    assert frames[-1] == {"done": True, "n_tokens": len(want)}
+    if len(want) > 3:  # chunk_steps=2 → streaming really was incremental
+        assert len(token_frames) >= 2
+    assert whole["token_ids"] == want
+
+
+def test_generate_stream_501_for_non_engine_models(model_and_params):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.serve.model import BucketSpec
+    from kubeflow_tpu.serve.server import ModelServer
+    from kubeflow_tpu.serve.generate import LMRuntimeModel
+
+    m = LMRuntimeModel(
+        "plain", None, config=CFG, max_new_tokens=4,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)), eos_id=EOS,
+    )
+    m.load()
+    server = ModelServer([m])
+
+    async def drive():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post(
+                "/v2/models/plain/generate_stream", json={"input_ids": [3]}
+            )
+            return r.status
+
+    assert asyncio.run(drive()) == 501
+
+
+def test_stop_fails_inflight_requests_promptly(model_and_params):
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=1, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    errors: list[Exception] = []
+
+    def worker():
+        try:
+            eng.submit([3, 4, 5] * 4, max_new_tokens=24, timeout_s=60)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    time.sleep(0.3)  # let it admit / start decoding
+    t0 = time.monotonic()
+    eng.stop()
+    th.join(20)
+    assert not th.is_alive()
+    # the submit either completed before stop() or failed PROMPTLY with
+    # the truth — never a 60s timeout hang
+    assert time.monotonic() - t0 < 15
+    if errors:
+        assert "stopped" in str(errors[0])
+
+
+def test_sse_disconnect_frees_the_row(model_and_params):
+    """Client walks away mid-stream: the engine row must be RELEASED (next
+    request on a max_batch=1 engine proceeds), not decode to completion
+    for nobody."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.serve.engine import LMEngineModel
+    from kubeflow_tpu.serve.model import BucketSpec
+    from kubeflow_tpu.serve.server import ModelServer
+
+    model, params = model_and_params
+    m = LMEngineModel(
+        "lm", None, config=CFG, max_batch=1, chunk_steps=1,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+        max_new_tokens=64, eos_id=EOS,
+    )
+    m.load()
+    m._params = jax.device_put(params)
+    m.engine.stop()
+    m.engine = LMEngine(
+        m._model, CFG, params, max_batch=1, max_seq=128, chunk_steps=1,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    server = ModelServer([m])
+
+    async def drive():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post(
+                "/v2/models/lm/generate_stream",
+                json={"input_ids": [3, 5, 7]},
+            )
+            assert r.status == 200
+            # read ONE frame, then abandon the stream
+            async for line in r.content:
+                if line.decode().startswith("data: "):
+                    break
+            r.close()
+            # the single row must come free for the next request
+            r2 = await client.post(
+                "/v2/models/lm/generate", json={"input_ids": [9, 2, 4]}
+            )
+            assert r2.status == 200
+            return await r2.json()
+
+    try:
+        out = asyncio.run(drive())
+        assert isinstance(out["token_ids"], list)
+    finally:
+        m.unload()
