@@ -805,6 +805,119 @@ def _torch_generate_tps(batch: int = 8) -> float:
 
 
 # --------------------------------------------------------------------------- #
+# config 7 (beyond BASELINE): continuous-batching serving throughput — the
+# vLLM-scheduler analog (serve/engine.py). 16 mixed-length requests arrive
+# CONCURRENTLY; the engine shares one decode batch. Baseline = the same 16
+# served one-at-a-time through the whole-batch generate path (what a server
+# without continuous batching does under concurrent load).
+# --------------------------------------------------------------------------- #
+
+
+def bench_engine() -> dict:
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+    from kubeflow_tpu.serve.engine import LMEngine
+    from kubeflow_tpu.serve.generate import make_generate_fn
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = TransformerConfig(
+        vocab_size=32768,
+        d_model=1024 if on_tpu else 128,
+        n_layers=12 if on_tpu else 2,
+        n_heads=16 if on_tpu else 4,
+        d_ff=4096 if on_tpu else 256,
+        causal=True,
+        attn_impl="flash" if on_tpu else "reference",
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    max_new = 48
+    rng = np.random.default_rng(0)
+    # mixed prompt lengths; every request gets the SAME token budget so the
+    # sequential baseline does identical work (its generate program always
+    # runs max_new steps — per-request budgets would unfairly pad its time)
+    requests = [
+        [int(t) for t in rng.integers(2, cfg.vocab_size, size=int(n))]
+        for n in rng.integers(16, 120, size=16)
+    ]
+    budgets = [max_new] * 16
+
+    eng = LMEngine(
+        model, cfg, params, max_batch=8, max_seq=192, chunk_steps=8,
+        prefill_buckets=(128,), eos_id=1,
+    ).start()
+    try:
+        for _ in range(2):  # compile prefill + chunk
+            eng.submit(requests[0][:16], max_new_tokens=8)
+        outs: dict[int, list[int]] = {}
+
+        def worker(i):
+            outs[i] = eng.submit(requests[i], max_new_tokens=budgets[i])
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        t_engine = time.perf_counter() - t0
+        engine_tokens = sum(len(v) for v in outs.values())
+    finally:
+        eng.stop()
+
+    # baseline: same requests, one at a time, whole-batch generate path
+    gen = jax.jit(make_generate_fn(model, cfg, max_new_tokens=max_new, eos_id=1))
+    prompt0 = np.zeros((1, 128), np.int32)
+    prompt0[0, : len(requests[0])] = requests[0]
+    _ = gen(params, prompt0, np.asarray([len(requests[0])], np.int32),
+            jax.random.PRNGKey(0), np.zeros((1,), np.float32))  # compile
+    seq_tokens = 0
+    t0 = time.perf_counter()
+    for i, ids in enumerate(requests):
+        prompt = np.zeros((1, 128), np.int32)
+        prompt[0, : len(ids)] = ids
+        toks, n_valid = gen(
+            params, prompt, np.asarray([len(ids)], np.int32),
+            jax.random.PRNGKey(i), np.zeros((1,), np.float32),
+        )
+        seq_tokens += min(int(np.asarray(n_valid)[0]), budgets[i])
+    t_seq = time.perf_counter() - t0
+
+    tok_per_s = engine_tokens / t_engine
+    seq_tok_per_s = seq_tokens / t_seq if t_seq > 0 else float("nan")
+    return {
+        "metric": "engine_concurrent_throughput",
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tok_per_s / seq_tok_per_s, 3),
+        "detail": {
+            "requests": 16,
+            "max_batch": 8,
+            "chunk_steps": 8,
+            "engine_tokens": engine_tokens,
+            "engine_seconds": round(t_engine, 3),
+            "sequential_tokens_per_s": round(seq_tok_per_s, 1),
+            "model": ("1024d x 12L" if on_tpu else "tiny-cpu"),
+            "baseline_is": (
+                "same 16 mixed-length requests served one-at-a-time "
+                "through the whole-batch generate path (a server without "
+                "continuous batching under concurrent load)"
+            ),
+        },
+    }
+
+
+# --------------------------------------------------------------------------- #
 
 
 def _probe_backend(timeout_s: float = 120.0) -> str:
@@ -817,14 +930,15 @@ def _probe_backend(timeout_s: float = 120.0) -> str:
 
 def main() -> int:
     device_benches = (
-        bench_mnist, bench_resnet, bench_bert, bench_serving, bench_generate
+        bench_mnist, bench_resnet, bench_bert, bench_serving, bench_generate,
+        bench_engine,
     )
     backend = _probe_backend()
     alive = backend != "unreachable"
     results: list[dict] = []
     for fn in (
         bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving,
-        bench_generate,
+        bench_generate, bench_engine,
     ):
         if fn in device_benches and not alive:
             r = {

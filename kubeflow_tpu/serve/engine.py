@@ -270,10 +270,15 @@ class LMEngine:
         )
         self._pending.put(req)
         self._work.set()
-        if self._stop.is_set() and not req.done.is_set():
-            # raced stop()'s drain: fail it ourselves (double-finish from
-            # the drain is harmless — same error, idempotent events)
+        if (
+            self._stop.is_set() or self._fatal is not None
+        ) and not req.done.is_set():
+            # raced stop()'s or the crash handler's drain: fail it ourselves
+            # (double-finish from the drain is harmless — idempotent events)
             req.error = RuntimeError("LM engine stopped")
+            if self._fatal is not None:
+                req.error = RuntimeError("LM engine is dead")
+                req.error.__cause__ = self._fatal
             req.finish()
         return req
 

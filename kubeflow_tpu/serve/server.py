@@ -215,6 +215,10 @@ class ModelServer:
                 reason=f"model '{name}' does not support streaming "
                 "(causal-lm-engine runtimes do)"
             )
+        if not model.ready:  # same 503 contract as DataPlane.infer
+            raise web.HTTPServiceUnavailable(
+                reason=f"model '{name}' not ready"
+            )
         try:
             body = await req.json()
             row = model.preprocess({"instances": [body]})[0]
