@@ -76,6 +76,10 @@ class _Request:
         self.done.set()
 
 
+class EngineOverloaded(RuntimeError):
+    """Admission queue full — callers should shed load (HTTP 429)."""
+
+
 class LMEngine:
     """Continuous-batching engine over a TransformerLM + params.
 
@@ -97,6 +101,7 @@ class LMEngine:
         eos_id: int = 1,
         pad_id: int = 0,
         seed: int = 0,
+        max_queue: int = 64,
     ):
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
@@ -106,6 +111,7 @@ class LMEngine:
         self.chunk_steps = chunk_steps
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.eos_id, self.pad_id = eos_id, pad_id
+        self.max_queue = max_queue
         self._rng = jax.random.PRNGKey(seed)
 
         # device state: the persistent cache. Everything per-row and small
@@ -258,6 +264,15 @@ class LMEngine:
             # a submit racing (or following) stop() must fail NOW — the
             # scheduler thread is gone and nothing would ever service it
             raise RuntimeError("LM engine stopped")
+        # bounded admission: total outstanding work (rows decoding + queue)
+        # beyond max_batch + max_queue is shed — an unbounded tail would
+        # wait longer than any client timeout
+        occupied = sum(s is not None for s in self._slots)
+        if self._pending.qsize() + occupied >= self.max_batch + self.max_queue:
+            raise EngineOverloaded(
+                f"engine at capacity ({occupied} decoding, "
+                f"{self._pending.qsize()} queued, max_queue={self.max_queue})"
+            )
         bucket = self._bucket(len(ids))
         if bucket + max_new_tokens > self.max_seq:
             raise ValueError(
@@ -474,6 +489,37 @@ class LMEngine:
                     self._finish(row)
 
 
+class _AdmittedStream:
+    """Iterator wrapper that releases exactly one admission slot however
+    the stream ends: exhaustion, error, or close before first next()."""
+
+    def __init__(self, gen, release):
+        self._gen = gen
+        self._release = release
+        self._released = False
+
+    def _release_once(self) -> None:
+        if not self._released:
+            self._released = True
+            self._release()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except BaseException:  # StopIteration included: stream is over
+            self._release_once()
+            raise
+
+    def close(self) -> None:
+        try:
+            self._gen.close()  # cancels the engine row (stream's finally)
+        finally:
+            self._release_once()
+
+
 def _sample(logits, rng, temperature):
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
@@ -500,6 +546,12 @@ class LMEngineModel(LMRuntimeModel):
         )
         self.engine: LMEngine | None = None
         self._executor = None
+        # admission control happens HERE, on the caller's thread: the
+        # private executor is sized max_batch, so without this check excess
+        # requests would queue invisibly in the executor (never reaching
+        # the engine's own bounded queue) and wait unboundedly
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
     def load(self) -> bool:
         super().load()  # restores params, device_put
@@ -546,31 +598,73 @@ class LMEngineModel(LMRuntimeModel):
         )
         return {"token_ids": toks}
 
+    def _admit(self, n_rows: int) -> None:
+        eng = self.engine  # snapshot: unload() may null it concurrently
+        if eng is None:
+            raise RuntimeError(f"model {self.name!r} is unloaded")
+        cap = self._engine_max_batch + eng.max_queue
+        with self._inflight_lock:
+            if self._inflight + n_rows > cap:
+                raise EngineOverloaded(
+                    f"{self._inflight} rows in flight (capacity {cap})"
+                )
+            self._inflight += n_rows
+
+    def _release(self, n_rows: int) -> None:
+        with self._inflight_lock:
+            self._inflight -= n_rows
+
     def predict(self, rows, headers=None) -> list[dict]:
         # sync path (gRPC, batcher): fan rows out so they share the decode
-        # batch with each other and with everyone else's requests
-        return list(self._executor.map(self._submit_row, rows))
+        # batch with each other and with everyone else's requests. Release
+        # only after EVERY row settles — an early release while sibling
+        # rows still run would let new requests past the admission cap.
+        import concurrent.futures as cf
+
+        self._admit(len(rows))
+        futs = [self._executor.submit(self._submit_row, r) for r in rows]
+        try:
+            cf.wait(futs)
+        finally:
+            self._release(len(rows))
+        return [f.result() for f in futs]
 
     def stream_row_tokens(self, row):
-        """Blocking generator of token-chunks for one preprocessed row —
-        the server's generate_stream (SSE) hook."""
-        yield from self.engine.stream(
+        """Token-chunk iterator for one preprocessed row — the server's
+        generate_stream (SSE) hook. Admission happens EAGERLY (here, not at
+        first next()) so overload raises before the server commits a 200;
+        the wrapper guarantees release even for a stream that is closed
+        before its first next() (a bare generator's finally wouldn't run)."""
+        self._admit(1)
+        gen = self.engine.stream(
             row["ids"],
             max_new_tokens=self.max_new_tokens,
             temperature=row["temperature"],
         )
+        return _AdmittedStream(gen, lambda: self._release(1))
 
     async def __call__(self, payload, headers=None):
         import asyncio
 
         rows = self.preprocess(payload, headers)
-        loop = asyncio.get_running_loop()
-        outs = await asyncio.gather(
-            *[
-                loop.run_in_executor(self._executor, self._submit_row, r)
-                for r in rows
-            ]
-        )
+        self._admit(len(rows))
+        try:
+            loop = asyncio.get_running_loop()
+            # return_exceptions: wait for EVERY row before releasing the
+            # inflight count, else a fast-failing row under-counts while
+            # its siblings still occupy engine capacity
+            outs = await asyncio.gather(
+                *[
+                    loop.run_in_executor(self._executor, self._submit_row, r)
+                    for r in rows
+                ],
+                return_exceptions=True,
+            )
+        finally:
+            self._release(len(rows))
+        for o in outs:
+            if isinstance(o, BaseException):
+                raise o
         return self.postprocess(list(outs), headers)
 
 

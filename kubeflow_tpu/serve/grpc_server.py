@@ -22,6 +22,7 @@ from typing import Any
 import grpc
 import numpy as np
 
+from kubeflow_tpu.serve.engine import EngineOverloaded
 from kubeflow_tpu.serve.protocol import _NP_TO_V2, _V2_TO_NP
 from kubeflow_tpu.serve.protos import open_inference_pb2 as pb
 from kubeflow_tpu.serve.server import DataPlane
@@ -189,6 +190,8 @@ class GrpcInferenceServer:
             ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e.reason))
         except ValueError as e:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except EngineOverloaded as e:  # shed load, don't 500
+            ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         preds = result["predictions"] if isinstance(result, dict) else result
         resp = pb.ModelInferResponse(model_name=req.model_name, id=req.id)
         tensor, raw = encode_output_tensor("output_0", np.asarray(preds))

@@ -31,6 +31,7 @@ from aiohttp import web
 
 from kubeflow_tpu.serve import protocol
 from kubeflow_tpu.serve.batcher import Batcher, BatcherConfig
+from kubeflow_tpu.serve.engine import EngineOverloaded
 from kubeflow_tpu.serve.logger import RequestLogger
 from kubeflow_tpu.serve.model import Model
 
@@ -199,6 +200,8 @@ class ModelServer:
             )
         except ValueError as e:  # same 400 contract as /infer and :predict
             raise web.HTTPBadRequest(reason=str(e))
+        except EngineOverloaded as e:
+            raise web.HTTPTooManyRequests(reason=str(e))
         return web.json_response(result["predictions"][0])
 
     async def _v2_generate_stream(self, req: web.Request) -> web.StreamResponse:
@@ -233,6 +236,13 @@ class ModelServer:
             )
         t0 = time.perf_counter()
 
+        try:
+            # admission is EAGER in stream_row_tokens: overload raises here,
+            # before any response bytes commit, and becomes a clean 429
+            gen = stream_rows(row)
+        except EngineOverloaded as e:
+            raise web.HTTPTooManyRequests(reason=str(e))
+
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -245,8 +255,6 @@ class ModelServer:
         disconnected = threading.Event()
 
         def pump() -> None:
-            gen = stream_rows(row)
-
             def emit(item) -> None:
                 try:
                     loop.call_soon_threadsafe(frames.put_nowait, item)
@@ -318,6 +326,8 @@ class ModelServer:
             result = await self.dataplane.infer(name, body, dict(req.headers))
         except ValueError as e:
             raise web.HTTPBadRequest(reason=str(e))
+        except EngineOverloaded as e:
+            raise web.HTTPTooManyRequests(reason=str(e))
         return web.json_response(protocol.encode_v1(result))
 
     async def _v1_explain(self, req: web.Request) -> web.Response:
@@ -378,6 +388,20 @@ class ModelServer:
                 p99 = srt[min(len(srt) - 1, int(len(srt) * 0.99))]
                 lines.append(f'kubeflow_tpu_latency_p50_ms{{model="{name}"}} {p50:.3f}')
                 lines.append(f'kubeflow_tpu_latency_p99_ms{{model="{name}"}} {p99:.3f}')
+        # engine-backed models export their scheduler gauges too
+        for name in self.dataplane.list_models():
+            model = self.dataplane.get(name)
+            eng = getattr(model, "engine", None)
+            if eng is None or not hasattr(eng, "stats"):
+                continue
+            for key, val in eng.stats.items():
+                lines.append(
+                    f'kubeflow_tpu_engine_{key}{{model="{name}"}} {val}'
+                )
+            lines.append(
+                f'kubeflow_tpu_engine_active_rows{{model="{name}"}} '
+                f"{int(eng.active.sum())}"
+            )
         return web.Response(text="\n".join(lines) + "\n")
 
     # -- runtime ------------------------------------------------------------
