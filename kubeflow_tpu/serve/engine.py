@@ -102,6 +102,7 @@ class LMEngine:
         pad_id: int = 0,
         seed: int = 0,
         max_queue: int = 64,
+        prefix_cache_entries: int = 0,
     ):
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
@@ -135,10 +136,25 @@ class LMEngine:
         self._thread: threading.Thread | None = None
         self.stats = {
             "admitted": 0, "completed": 0, "chunks": 0,
-            "max_concurrent": 0,
+            "max_concurrent": 0, "prefix_hits": 0, "prefix_tokens_reused": 0,
         }
 
+        # prefix cache (vLLM automatic-prefix-caching analog): completed
+        # prompt prefills donate their KV, keyed by the prompt ids rounded
+        # DOWN to a 16-token multiple — quantizing keeps the compiled
+        # extract/implant/suffix-prefill programs to a bounded shape set
+        # and the reused region contiguous (no junk slots mid-row).
+        from collections import OrderedDict
+
+        self._prefix_cache: "OrderedDict[tuple, dict] | None" = (
+            OrderedDict() if prefix_cache_entries > 0 else None
+        )
+        self._prefix_cache_entries = prefix_cache_entries
+
         self._prefill = jax.jit(self._prefill_impl)
+        self._suffix_prefill = jax.jit(self._suffix_prefill_impl)
+        self._implant = jax.jit(self._implant_impl)
+        self._extract_jits: dict[int, Any] = {}
         self._chunk = jax.jit(self._chunk_impl)
 
     # -- device programs ---------------------------------------------------- #
@@ -173,6 +189,79 @@ class LMEngine:
             for name in cache
         }
         return cache, tok, tok != self.eos_id
+
+    def _suffix_prefill_impl(
+        self, cache, suffix, slen, offset, row, temperature, rng
+    ):
+        """Prefill only the SUFFIX of a prompt whose first ``offset`` slots
+        of row ``row`` already hold reused prefix KV. ``cache_index=offset``
+        gives the default causal mask and rope positions the right absolute
+        coordinates, so this is bit-for-bit the tail of a full prefill."""
+        row_cache = {
+            name: {
+                "k": jax.lax.dynamic_slice_in_dim(lc["k"], row, 1, axis=0),
+                "v": jax.lax.dynamic_slice_in_dim(lc["v"], row, 1, axis=0),
+            }
+            for name, lc in cache.items()
+        }
+        logits, row_cache = self.model.apply(
+            {"params": self.params}, suffix, cache=row_cache,
+            cache_index=offset,
+        )
+        last = jnp.take_along_axis(
+            logits, (slen - 1)[:, None, None], axis=1
+        )[:, 0]
+        tok = _sample(last, rng, temperature[None])[0]
+        cache = {
+            name: {
+                "k": jax.lax.dynamic_update_slice_in_dim(
+                    cache[name]["k"], row_cache[name]["k"], row, axis=0
+                ),
+                "v": jax.lax.dynamic_update_slice_in_dim(
+                    cache[name]["v"], row_cache[name]["v"], row, axis=0
+                ),
+            }
+            for name in cache
+        }
+        return cache, tok, tok != self.eos_id
+
+    def _implant_impl(self, cache, stored, row):
+        """Copy a stored prefix's KV (1, H, n16, D per layer) into the
+        FRONT of cache row ``row``."""
+        return {
+            name: {
+                "k": jax.lax.dynamic_update_slice(
+                    cache[name]["k"], stored[name]["k"], (row, 0, 0, 0)
+                ),
+                "v": jax.lax.dynamic_update_slice(
+                    cache[name]["v"], stored[name]["v"], (row, 0, 0, 0)
+                ),
+            }
+            for name in cache
+        }
+
+    def _extract_prefix(self, row: int, n16: int):
+        """Slice row ``row``'s first n16 KV slots (one jit per n16 — the
+        16-multiple quantization bounds this set)."""
+        fn = self._extract_jits.get(n16)
+        if fn is None:
+            H, D = self.cfg.n_heads, self.cfg.head_dim
+
+            def impl(cache, row):
+                return {
+                    name: {
+                        "k": jax.lax.dynamic_slice(
+                            lc["k"], (row, 0, 0, 0), (1, H, n16, D)
+                        ),
+                        "v": jax.lax.dynamic_slice(
+                            lc["v"], (row, 0, 0, 0), (1, H, n16, D)
+                        ),
+                    }
+                    for name, lc in cache.items()
+                }
+
+            fn = self._extract_jits[n16] = jax.jit(impl)
+        return fn(self.cache, row)
 
     def _chunk_impl(
         self, cache, last_tok, real_len, gen_start, gen_count, active,
@@ -374,19 +463,85 @@ class LMEngine:
                 req.error = e
                 req.finish()
 
+    def _lookup_prefix(self, ids: list[int]):
+        """Longest stored prefix strictly shorter than the prompt (at least
+        one token must remain to prefill for the first-token logits).
+        Keys are exact 16-multiples, so only the prompt's own descending
+        16-multiples need O(1) dict probes — no scan over entries."""
+        if self._prefix_cache is None:
+            return None
+        top = (len(ids) - 1) // 16 * 16
+        for n16 in range(top, 15, -16):
+            key = tuple(ids[:n16])
+            entry = self._prefix_cache.get(key)
+            if entry is not None:
+                self._prefix_cache.move_to_end(key)
+                return key, entry
+        return None
+
+    def _store_prefix(self, ids: list[int], row: int) -> None:
+        """Donate row ``row``'s KV for ids[:n16] — the row's first n16 slots
+        must hold contiguous REAL tokens (true after a full prefill, and
+        after a hit's implant+suffix since real tokens stay contiguous)."""
+        n16 = (len(ids) // 16) * 16
+        if n16 < 16:
+            return
+        key = tuple(ids[:n16])
+        if key in self._prefix_cache:
+            self._prefix_cache.move_to_end(key)
+            return
+        self._prefix_cache[key] = self._extract_prefix(row, n16)
+        while len(self._prefix_cache) > self._prefix_cache_entries:
+            self._prefix_cache.popitem(last=False)
+
     def _admit(self, req: _Request, row: int) -> None:
-        bucket = self._bucket(len(req.ids))
-        prompt = np.full((1, bucket), self.pad_id, np.int32)
-        prompt[0, : len(req.ids)] = req.ids
         self._rng, sub = jax.random.split(self._rng)
-        self.cache, tok, valid = self._prefill(
-            self.cache,
-            jnp.asarray(prompt),
-            jnp.asarray([len(req.ids)], np.int32),
-            row,
-            jnp.float32(req.temperature),
-            sub,
-        )
+        hit = self._lookup_prefix(req.ids)
+        gen_start = None
+        if hit is not None:
+            key, stored = hit
+            n16 = len(key)
+            suffix_ids = req.ids[n16:]
+            # suffixes bucket at the 16-token prefix quantum, NOT the full
+            # prefill buckets — padding a 4-token tail to a 128 bucket
+            # would waste cache slots and blow the max_seq layout check
+            sbucket = ((len(suffix_ids) + 15) // 16) * 16
+            if n16 + sbucket + req.max_new_tokens <= self.max_seq:
+                # reuse: implant the prefix KV, prefill only the suffix
+                self.cache = self._implant(self.cache, stored, row)
+                suffix = np.full((1, sbucket), self.pad_id, np.int32)
+                suffix[0, : len(suffix_ids)] = suffix_ids
+                self.cache, tok, valid = self._suffix_prefill(
+                    self.cache,
+                    jnp.asarray(suffix),
+                    jnp.asarray([len(suffix_ids)], np.int32),
+                    n16,
+                    row,
+                    jnp.float32(req.temperature),
+                    sub,
+                )
+                gen_start = n16 + sbucket
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_reused"] += n16
+                # a hit can EXTEND the cache: the row now holds a longer
+                # contiguous real prefix than the entry that matched
+                self._store_prefix(req.ids, row)
+        if gen_start is None:
+            bucket = self._bucket(len(req.ids))
+            prompt = np.full((1, bucket), self.pad_id, np.int32)
+            prompt[0, : len(req.ids)] = req.ids
+            self.cache, tok, valid = self._prefill(
+                self.cache,
+                jnp.asarray(prompt),
+                jnp.asarray([len(req.ids)], np.int32),
+                row,
+                jnp.float32(req.temperature),
+                sub,
+            )
+            gen_start = bucket
+            if self._prefix_cache is not None:
+                self._store_prefix(req.ids, row)
+        bucket = gen_start
         tok = int(tok)
         req.row, req.gen_start = row, bucket
         self._slots[row] = req
@@ -536,11 +691,12 @@ class LMEngineModel(LMRuntimeModel):
 
     def __init__(
         self, name, storage_path=None, *, max_batch=8, max_seq=None,
-        chunk_steps=8, **kwargs,
+        chunk_steps=8, prefix_cache_entries=0, **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
         self._engine_chunk = chunk_steps
+        self._engine_prefix_entries = prefix_cache_entries
         self._engine_max_seq = max_seq or (
             self.buckets.seq_lens[-1] + self.max_new_tokens
         )
@@ -572,6 +728,7 @@ class LMEngineModel(LMRuntimeModel):
             chunk_steps=self._engine_chunk,
             prefill_buckets=self.buckets.seq_lens,
             eos_id=self.eos_id,
+            prefix_cache_entries=self._engine_prefix_entries,
         ).start()
         return True
 
@@ -585,10 +742,32 @@ class LMEngineModel(LMRuntimeModel):
         super().unload()
 
     def warmup(self) -> None:
-        # compile EVERY prefill bucket (a length-s prompt maps to bucket s)
-        # plus the chunk program, so no real request pays XLA compilation
-        for s in self.buckets.seq_lens:
-            self.engine.submit([2] * s, max_new_tokens=2)
+        """Compile every prefill bucket + the chunk program, and (when
+        prefix caching is on) the implant/extract/suffix-prefill programs —
+        so no real request pays XLA compilation. Distinct token patterns
+        per bucket stop one warmup prompt prefix-hitting another (which
+        would skip the larger bucket's compile), and the warmup entries are
+        cleared so they never occupy real LRU capacity."""
+        eng = self.engine
+        vocab = self.config.vocab_size
+        for i, s in enumerate(self.buckets.seq_lens):
+            eng.submit([2 + i % (vocab - 2)] * s, max_new_tokens=2)
+        if eng._prefix_cache is not None:
+            eng._prefix_cache.clear()
+            for j, n16 in enumerate(
+                range(16, self.buckets.seq_lens[-1], 16)
+            ):
+                if (
+                    n16 + 16 + 2 > eng.max_seq
+                    or eng._bucket(n16 + 1) + 2 > eng.max_seq
+                ):
+                    break
+                tok = 2 + (len(self.buckets.seq_lens) + j) % (vocab - 2)
+                # store an n16-long prefix, then hit it: compiles the
+                # extract(n16), implant(n16) and suffix-prefill programs
+                eng.submit([tok] * (n16 + 1), max_new_tokens=2)
+                eng.submit([tok] * n16 + [tok], max_new_tokens=2)
+            eng._prefix_cache.clear()
 
     def _submit_row(self, row) -> dict:
         toks = self.engine.submit(

@@ -603,3 +603,115 @@ def test_stream_overload_is_429_before_headers(model_and_params):
         assert out and all(isinstance(c, list) for c in out)
     finally:
         m.unload()
+
+
+def test_prefix_cache_exact_parity_and_reuse(model_and_params):
+    """Prefix caching is a COMPUTE optimization, never a numerics change:
+    completions with reused prefixes must equal the reference path exactly,
+    and the stats must prove reuse actually happened."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=2, max_seq=96, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS, prefix_cache_entries=4,
+    ).start()
+    try:
+        rng = np.random.default_rng(11)
+        system = [int(x) for x in rng.integers(2, CFG.vocab_size, size=20)]
+        # first request stores system[:16] as a prefix entry
+        first = system[:20]
+        out1 = eng.submit(first, max_new_tokens=10)
+        assert out1 == _reference_completion(model, params, first, 10)
+        assert eng.stats["prefix_hits"] == 0
+        # same 16-token prefix, different tails → every one must hit AND
+        # match the from-scratch reference bit for bit
+        for trial in range(3):
+            tail = [int(x) for x in rng.integers(2, CFG.vocab_size, size=5)]
+            ids = system[:16] + tail
+            got = eng.submit(ids, max_new_tokens=10)
+            want = _reference_completion(model, params, ids, 10)
+            assert got == want, (trial, got, want)
+        assert eng.stats["prefix_hits"] == 3
+        assert eng.stats["prefix_tokens_reused"] == 48
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_lru_eviction(model_and_params):
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=1, max_seq=96, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS, prefix_cache_entries=2,
+    ).start()
+    try:
+        rng = np.random.default_rng(13)
+        prompts = [
+            [int(x) for x in rng.integers(2, CFG.vocab_size, size=18)]
+            for _ in range(3)
+        ]
+        for p in prompts:  # three distinct 16-token prefixes, capacity 2
+            eng.submit(p, max_new_tokens=4)
+        assert len(eng._prefix_cache) == 2
+        # oldest evicted → resubmitting prompt 0 gets NO hit; prompt 2 does
+        eng.submit(prompts[0][:16] + [7, 8], max_new_tokens=4)
+        assert eng.stats["prefix_hits"] == 0
+        eng.submit(prompts[2][:16] + [7, 8], max_new_tokens=4)
+        assert eng.stats["prefix_hits"] == 1
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_respects_max_seq_fallback(model_and_params):
+    """A hit whose reuse layout would overflow max_seq must fall back to a
+    full prefill and still answer correctly."""
+    model, params = model_and_params
+    # a non-16-multiple bucket (20) makes the reuse layout (16 + 16 + 10 =
+    # 42) exceed max_seq=40 while the full-prefill layout (20 + 10) fits
+    eng = LMEngine(
+        model, CFG, params, max_batch=1, max_seq=40, chunk_steps=4,
+        prefill_buckets=(20,), eos_id=EOS, prefix_cache_entries=2,
+    ).start()
+    try:
+        rng = np.random.default_rng(17)
+        base = [int(x) for x in rng.integers(2, CFG.vocab_size, size=18)]
+        eng.submit(base, max_new_tokens=4)  # stores base[:16]
+        ids = base[:16] + [3, 4]
+        got = eng.submit(ids, max_new_tokens=10)
+        assert eng.stats["prefix_hits"] == 0  # fallback, not a broken hit
+        # reference path uses bucket 32; engine used 20 — same numerics
+        assert got == _reference_completion(model, params, ids, 10)
+    finally:
+        eng.stop()
+
+
+def test_warmup_compiles_all_buckets_and_prefix_path(model_and_params):
+    """After warmup with prefix caching on: every bucket's prefill, the
+    implant/extract shapes, and the suffix prefill are compiled, and the
+    warmup entries don't occupy the LRU."""
+    from kubeflow_tpu.serve.engine import LMEngineModel
+    from kubeflow_tpu.serve.model import BucketSpec
+
+    model, params = model_and_params
+    m = LMEngineModel(
+        "lm", None, config=CFG, max_batch=2, chunk_steps=2, max_seq=96,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(16, 32)),
+        max_new_tokens=8, eos_id=EOS, prefix_cache_entries=4,
+    )
+    m.load()
+    try:
+        m.warmup()
+        eng = m.engine
+        assert len(eng._prefix_cache) == 0  # no warmup pollution
+        # the suffix warm covered the 16-multiple extract shapes — proof
+        # the prefix path (implant/extract/suffix-prefill) compiled
+        assert 16 in eng._extract_jits
+        # a real shared-prefix workload immediately hits without compiling
+        rng = np.random.default_rng(23)
+        base = [int(x) for x in rng.integers(2, CFG.vocab_size, size=18)]
+        out1 = m.engine.submit(base, max_new_tokens=6)
+        out2 = m.engine.submit(base[:16] + [5, 6], max_new_tokens=6)
+        assert eng.stats["prefix_hits"] >= 1
+        assert out2 == _reference_completion(
+            model, params, base[:16] + [5, 6], 6
+        )
+    finally:
+        m.unload()
