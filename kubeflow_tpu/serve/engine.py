@@ -103,6 +103,7 @@ class LMEngine:
         seed: int = 0,
         max_queue: int = 64,
         prefix_cache_entries: int = 0,
+        prefix_cache_tokens: int | None = None,
     ):
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
@@ -150,45 +151,22 @@ class LMEngine:
             OrderedDict() if prefix_cache_entries > 0 else None
         )
         self._prefix_cache_entries = prefix_cache_entries
+        self._prefix_cache_tokens = prefix_cache_tokens
+        self._prefix_lens: dict[int, int] = {}  # stored length → count
+        self._prefix_tokens_stored = 0
 
-        self._prefill = jax.jit(self._prefill_impl)
+        # ONE prefill program: a full prefill IS a suffix prefill at
+        # offset 0 (same mask, same rope coordinates) — no second copy to
+        # keep in sync
         self._suffix_prefill = jax.jit(self._suffix_prefill_impl)
+        self._prefill = lambda cache, prompt, plen, row, t, rng: (
+            self._suffix_prefill(cache, prompt, plen, 0, row, t, rng)
+        )
         self._implant = jax.jit(self._implant_impl)
         self._extract_jits: dict[int, Any] = {}
         self._chunk = jax.jit(self._chunk_impl)
 
     # -- device programs ---------------------------------------------------- #
-
-    def _prefill_impl(self, cache, prompt, plen, row, temperature, rng):
-        """Prefill ONE request into cache row ``row``; returns (cache,
-        first_token, first_valid). prompt: (1, bucket) padded ids — one
-        compiled program per prefill bucket, none per admission."""
-        row_cache = {
-            name: {
-                "k": jax.lax.dynamic_slice_in_dim(lc["k"], row, 1, axis=0),
-                "v": jax.lax.dynamic_slice_in_dim(lc["v"], row, 1, axis=0),
-            }
-            for name, lc in cache.items()
-        }
-        logits, row_cache = self.model.apply(
-            {"params": self.params}, prompt, cache=row_cache, cache_index=0,
-        )
-        last = jnp.take_along_axis(logits, (plen - 1)[:, None, None], axis=1)[
-            :, 0
-        ]
-        tok = _sample(last, rng, temperature[None])[0]
-        cache = {
-            name: {
-                "k": jax.lax.dynamic_update_slice_in_dim(
-                    cache[name]["k"], row_cache[name]["k"], row, axis=0
-                ),
-                "v": jax.lax.dynamic_update_slice_in_dim(
-                    cache[name]["v"], row_cache[name]["v"], row, axis=0
-                ),
-            }
-            for name in cache
-        }
-        return cache, tok, tok != self.eos_id
 
     def _suffix_prefill_impl(
         self, cache, suffix, slen, offset, row, temperature, rng
@@ -471,7 +449,11 @@ class LMEngine:
         if self._prefix_cache is None:
             return None
         top = (len(ids) - 1) // 16 * 16
-        for n16 in range(top, 15, -16):
+        # probe only lengths ACTUALLY stored (descending): a long-prompt
+        # miss costs len(stored-lengths) tuple builds, not len(prompt)/16
+        for n16 in sorted(self._prefix_lens, reverse=True):
+            if n16 > top:
+                continue
             key = tuple(ids[:n16])
             entry = self._prefix_cache.get(key)
             if entry is not None:
@@ -491,8 +473,22 @@ class LMEngine:
             self._prefix_cache.move_to_end(key)
             return
         self._prefix_cache[key] = self._extract_prefix(row, n16)
-        while len(self._prefix_cache) > self._prefix_cache_entries:
-            self._prefix_cache.popitem(last=False)
+        self._prefix_lens[n16] = self._prefix_lens.get(n16, 0) + 1
+        self._prefix_tokens_stored += n16
+        # evict LRU until within BOTH bounds: entry count and (when set)
+        # total stored tokens — entry count alone lets HBM scale with
+        # prefix length (one 1024-token entry can be hundreds of MB)
+        while len(self._prefix_cache) > self._prefix_cache_entries or (
+            self._prefix_cache_tokens is not None
+            and self._prefix_tokens_stored > self._prefix_cache_tokens
+            and len(self._prefix_cache) > 1
+        ):
+            old_key, _ = self._prefix_cache.popitem(last=False)
+            n = len(old_key)
+            self._prefix_tokens_stored -= n
+            self._prefix_lens[n] -= 1
+            if not self._prefix_lens[n]:
+                del self._prefix_lens[n]
 
     def _admit(self, req: _Request, row: int) -> None:
         self._rng, sub = jax.random.split(self._rng)
@@ -754,6 +750,9 @@ class LMEngineModel(LMRuntimeModel):
             eng.submit([2 + i % (vocab - 2)] * s, max_new_tokens=2)
         if eng._prefix_cache is not None:
             eng._prefix_cache.clear()
+            eng._prefix_lens.clear()
+            eng._prefix_tokens_stored = 0
+            n_b = len(self.buckets.seq_lens)
             for j, n16 in enumerate(
                 range(16, self.buckets.seq_lens[-1], 16)
             ):
@@ -762,12 +761,39 @@ class LMEngineModel(LMRuntimeModel):
                     or eng._bucket(n16 + 1) + 2 > eng.max_seq
                 ):
                     break
-                tok = 2 + (len(self.buckets.seq_lens) + j) % (vocab - 2)
-                # store an n16-long prefix, then hit it: compiles the
-                # extract(n16), implant(n16) and suffix-prefill programs
+                tok = 2 + (n_b + j) % (vocab - 2)
+                # store an n16-long prefix: compiles extract(n16)
                 eng.submit([tok] * (n16 + 1), max_new_tokens=2)
-                eng.submit([tok] * n16 + [tok], max_new_tokens=2)
+                # the suffix-prefill program is keyed by SUFFIX shape alone
+                # (implant by n16), so sweep the sbucket shapes once (j==0)
+                # and afterwards one hit per n16 compiles its implant
+                sweep = (
+                    range(16, self.buckets.seq_lens[-1] + 1, 16)
+                    if j == 0
+                    else (16,)
+                )
+                for sbucket in sweep:
+                    slen = sbucket - 15
+                    try:
+                        full_bucket = eng._bucket(n16 + slen)
+                    except ValueError:
+                        break
+                    if (
+                        n16 + sbucket + 2 > eng.max_seq
+                        or full_bucket + 2 > eng.max_seq
+                    ):
+                        break
+                    tail_tok = 2 + (n_b + j + 1) % (vocab - 2)
+                    eng.submit(
+                        [tok] * n16 + [tail_tok] * slen, max_new_tokens=2
+                    )
             eng._prefix_cache.clear()
+            eng._prefix_lens.clear()
+            eng._prefix_tokens_stored = 0
+        # warmup traffic must not pollute production metrics (/metrics
+        # gauges, hit rates) — counters restart at zero
+        for key in eng.stats:
+            eng.stats[key] = 0
 
     def _submit_row(self, row) -> dict:
         toks = self.engine.submit(

@@ -715,3 +715,25 @@ def test_warmup_compiles_all_buckets_and_prefix_path(model_and_params):
         )
     finally:
         m.unload()
+
+
+def test_prefix_cache_token_budget_eviction(model_and_params):
+    """prefix_cache_tokens bounds TOTAL stored KV tokens (the HBM cost),
+    evicting LRU entries — entry count alone would let memory scale with
+    prefix length."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=1, max_seq=96, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS,
+        prefix_cache_entries=64, prefix_cache_tokens=32,
+    ).start()
+    try:
+        rng = np.random.default_rng(29)
+        for _ in range(3):  # three 16-token entries against a 32 budget
+            ids = [int(x) for x in rng.integers(2, CFG.vocab_size, size=18)]
+            eng.submit(ids, max_new_tokens=4)
+        assert eng._prefix_tokens_stored <= 32
+        assert len(eng._prefix_cache) == 2
+        assert sum(k * v for k, v in eng._prefix_lens.items()) == 32
+    finally:
+        eng.stop()
