@@ -157,14 +157,22 @@ class LMEngine:
 
         # ONE prefill program: a full prefill IS a suffix prefill at
         # offset 0 (same mask, same rope coordinates) — no second copy to
-        # keep in sync
-        self._suffix_prefill = jax.jit(self._suffix_prefill_impl)
+        # keep in sync. The cache argument is DONATED everywhere: without
+        # donation every prefill/implant/chunk call copies the entire
+        # (max_batch, H, max_seq, D) x layers x 2 KV tree — pure HBM
+        # bandwidth waste since the engine always rebinds self.cache to
+        # the result. (A failed donated call kills the buffers; the
+        # scheduler's fatal path already fails all requests and the
+        # engine is rebuilt on reload.)
+        self._suffix_prefill = jax.jit(
+            self._suffix_prefill_impl, donate_argnums=(0,)
+        )
         self._prefill = lambda cache, prompt, plen, row, t, rng: (
             self._suffix_prefill(cache, prompt, plen, 0, row, t, rng)
         )
-        self._implant = jax.jit(self._implant_impl)
+        self._implant = jax.jit(self._implant_impl, donate_argnums=(0,))
         self._extract_jits: dict[int, Any] = {}
-        self._chunk = jax.jit(self._chunk_impl)
+        self._chunk = jax.jit(self._chunk_impl, donate_argnums=(0,))
 
     # -- device programs ---------------------------------------------------- #
 
@@ -437,9 +445,12 @@ class LMEngine:
             row = free[0]
             try:
                 self._admit(req, row)
-            except Exception as e:  # bad request: fail it, keep serving
+            except ValueError as e:  # bad request: fail it, keep serving
                 req.error = e
                 req.finish()
+            # anything else (device error mid-donated-call) propagates to
+            # the fatal path: self.cache may now hold DELETED buffers, so
+            # "keep serving" would fail every later call confusingly
 
     def _lookup_prefix(self, ids: list[int]):
         """Longest stored prefix strictly shorter than the prompt (at least
@@ -466,7 +477,10 @@ class LMEngine:
         must hold contiguous REAL tokens (true after a full prefill, and
         after a hit's implant+suffix since real tokens stay contiguous)."""
         n16 = (len(ids) // 16) * 16
-        if n16 < 16:
+        if n16 < 16 or (
+            self._prefix_cache_tokens is not None
+            and n16 > self._prefix_cache_tokens
+        ):
             return
         key = tuple(ids[:n16])
         if key in self._prefix_cache:
@@ -551,23 +565,25 @@ class LMEngine:
         self.last_tok[row] = tok
         # one-token completions (eos first, or budget 1) finish here
         finished = (not bool(valid)) or req.max_new_tokens <= 1
+        self.stats["admitted"] += 1
+        self.stats["max_concurrent"] = max(
+            self.stats["max_concurrent"], sum(s is not None for s in self._slots)
+        )
         if finished:
             self._finish(row)
         else:
             self.active[row] = True
             self.gen_count[row] = 1
-        self.stats["admitted"] += 1
-        self.stats["max_concurrent"] = max(
-            self.stats["max_concurrent"], sum(s is not None for s in self._slots)
-        )
 
     def _finish(self, row: int) -> None:
         req = self._slots[row]
         self._slots[row] = None
         self.active[row] = False
         if req is not None:
-            req.finish()
+            # count BEFORE done.set(): callers may read/reset stats the
+            # moment their submit returns (warmup does)
             self.stats["completed"] += 1
+            req.finish()
 
     def _loop(self) -> None:
         try:
@@ -687,12 +703,14 @@ class LMEngineModel(LMRuntimeModel):
 
     def __init__(
         self, name, storage_path=None, *, max_batch=8, max_seq=None,
-        chunk_steps=8, prefix_cache_entries=0, **kwargs,
+        chunk_steps=8, prefix_cache_entries=0, prefix_cache_tokens=None,
+        **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
         self._engine_chunk = chunk_steps
         self._engine_prefix_entries = prefix_cache_entries
+        self._engine_prefix_tokens = prefix_cache_tokens
         self._engine_max_seq = max_seq or (
             self.buckets.seq_lens[-1] + self.max_new_tokens
         )
@@ -725,6 +743,7 @@ class LMEngineModel(LMRuntimeModel):
             prefill_buckets=self.buckets.seq_lens,
             eos_id=self.eos_id,
             prefix_cache_entries=self._engine_prefix_entries,
+            prefix_cache_tokens=self._engine_prefix_tokens,
         ).start()
         return True
 
@@ -772,7 +791,7 @@ class LMEngineModel(LMRuntimeModel):
                     if j == 0
                     else (16,)
                 )
-                for sbucket in sweep:
+                for si, sbucket in enumerate(sweep):
                     slen = sbucket - 15
                     try:
                         full_bucket = eng._bucket(n16 + slen)
@@ -783,7 +802,12 @@ class LMEngineModel(LMRuntimeModel):
                         or full_bucket + 2 > eng.max_seq
                     ):
                         break
-                    tail_tok = 2 + (n_b + j + 1) % (vocab - 2)
+                    # distinct per step: a repeated tail would let the
+                    # previous step's store-on-hit extension absorb this
+                    # step's suffix into an already-compiled shape
+                    tail_tok = 2 + (n_b + j + 1 + si) % (vocab - 2)
+                    if tail_tok == tok:
+                        tail_tok = 2 + (tail_tok - 1) % (vocab - 2)
                     eng.submit(
                         [tok] * n16 + [tail_tok] * slen, max_new_tokens=2
                     )
