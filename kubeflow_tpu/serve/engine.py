@@ -104,11 +104,38 @@ class LMEngine:
         max_queue: int = 64,
         prefix_cache_entries: int = 0,
         prefix_cache_tokens: int | None = None,
+        mesh=None,
+        rules=None,
     ):
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
         self.model, self.cfg = model, cfg
-        self.params = jax.device_put(params)
+        self.mesh = mesh
+        if mesh is not None:
+            # tensor-parallel serving: params laid out by the SAME rules as
+            # training (parallel/sharding.py) and the KV cache sharded over
+            # heads on the model axis — GSPMD then compiles every engine
+            # program (prefill/implant/chunk) with the right collectives.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from kubeflow_tpu.parallel.sharding import transformer_rules
+
+            rules = rules or transformer_rules(fsdp=False)
+            specs = rules(params)
+            rules.validate_divisibility(
+                params, dict(zip(mesh.axis_names, mesh.devices.shape))
+            )
+            self.params = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                params, specs,
+            )
+            self._cache_sharding = NamedSharding(
+                mesh, P(None, "model", None, None)
+            )
+        else:
+            self.params = jax.device_put(params)
+            self._cache_sharding = None
         self.max_batch, self.max_seq = max_batch, max_seq
         self.chunk_steps = chunk_steps
         self.prefill_buckets = tuple(sorted(prefill_buckets))
@@ -119,7 +146,16 @@ class LMEngine:
         # device state: the persistent cache. Everything per-row and small
         # (lengths, last tokens, activity) lives host-side as numpy — it
         # rides into each chunk call and costs nothing next to the cache.
-        self.cache = init_kv_cache(cfg, max_batch, max_seq)
+        if self._cache_sharding is not None:
+            # allocate DIRECTLY in the sharded layout: materialising the
+            # full tree on one device first would OOM exactly the
+            # deployments TP serving exists for
+            self.cache = jax.jit(
+                lambda: init_kv_cache(cfg, max_batch, max_seq),
+                out_shardings=self._cache_sharding,
+            )()
+        else:
+            self.cache = init_kv_cache(cfg, max_batch, max_seq)
         self.real_len = np.zeros((max_batch,), np.int32)   # prompt length
         self.gen_start = np.zeros((max_batch,), np.int32)  # first gen slot
         self.gen_count = np.zeros((max_batch,), np.int32)  # tokens so far
@@ -704,13 +740,15 @@ class LMEngineModel(LMRuntimeModel):
     def __init__(
         self, name, storage_path=None, *, max_batch=8, max_seq=None,
         chunk_steps=8, prefix_cache_entries=0, prefix_cache_tokens=None,
-        **kwargs,
+        mesh=None, rules=None, **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
         self._engine_chunk = chunk_steps
         self._engine_prefix_entries = prefix_cache_entries
         self._engine_prefix_tokens = prefix_cache_tokens
+        self._engine_mesh = mesh
+        self._engine_rules = rules
         self._engine_max_seq = max_seq or (
             self.buckets.seq_lens[-1] + self.max_new_tokens
         )
@@ -744,6 +782,8 @@ class LMEngineModel(LMRuntimeModel):
             eos_id=self.eos_id,
             prefix_cache_entries=self._engine_prefix_entries,
             prefix_cache_tokens=self._engine_prefix_tokens,
+            mesh=self._engine_mesh,
+            rules=self._engine_rules,
         ).start()
         return True
 
