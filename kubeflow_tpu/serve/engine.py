@@ -104,6 +104,7 @@ class LMEngine:
         max_queue: int = 64,
         prefix_cache_entries: int = 0,
         prefix_cache_tokens: int | None = None,
+        prefill_chunk: int | None = None,
         mesh=None,
         rules=None,
     ):
@@ -141,6 +142,16 @@ class LMEngine:
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.eos_id, self.pad_id = eos_id, pad_id
         self.max_queue = max_queue
+        if prefill_chunk is not None and (
+            prefill_chunk < 16 or prefill_chunk % 16
+        ):
+            raise ValueError("prefill_chunk must be a multiple of 16")
+        #: chunked prefill (vLLM analog): long prompts prefill in
+        #: prefill_chunk-token pieces INTERLEAVED with decode chunks, so an
+        #: admission never stalls in-flight rows for a whole long prefill.
+        #: None = each prompt prefills in one piece (its full bucket).
+        self.prefill_chunk = prefill_chunk
+        self._prefilling: dict[int, dict] = {}
         self._rng = jax.random.PRNGKey(seed)
 
         # device state: the persistent cache. Everything per-row and small
@@ -174,6 +185,7 @@ class LMEngine:
         self.stats = {
             "admitted": 0, "completed": 0, "chunks": 0,
             "max_concurrent": 0, "prefix_hits": 0, "prefix_tokens_reused": 0,
+            "prefill_pieces": 0,
         }
 
         # prefix cache (vLLM automatic-prefix-caching analog): completed
@@ -202,9 +214,6 @@ class LMEngine:
         # engine is rebuilt on reload.)
         self._suffix_prefill = jax.jit(
             self._suffix_prefill_impl, donate_argnums=(0,)
-        )
-        self._prefill = lambda cache, prompt, plen, row, t, rng: (
-            self._suffix_prefill(cache, prompt, plen, 0, row, t, rng)
         )
         self._implant = jax.jit(self._implant_impl, donate_argnums=(0,))
         self._extract_jits: dict[int, Any] = {}
@@ -384,10 +393,16 @@ class LMEngine:
                 f"engine at capacity ({occupied} decoding, "
                 f"{self._pending.qsize()} queued, max_queue={self.max_queue})"
             )
-        bucket = self._bucket(len(ids))
-        if bucket + max_new_tokens > self.max_seq:
+        if self.prefill_chunk is not None:
+            # chunked prefill frees prompts from the bucket bound: the only
+            # limit is the piece layout fitting max_seq
+            C = self.prefill_chunk
+            layout = -(-len(ids) // C) * C
+        else:
+            layout = self._bucket(len(ids))
+        if layout + max_new_tokens > self.max_seq:
             raise ValueError(
-                f"prompt bucket {bucket} + max_new_tokens {max_new_tokens} "
+                f"prompt layout {layout} + max_new_tokens {max_new_tokens} "
                 f"exceeds engine max_seq {self.max_seq}"
             )
         req = _Request(
@@ -541,9 +556,13 @@ class LMEngine:
                 del self._prefix_lens[n]
 
     def _admit(self, req: _Request, row: int) -> None:
-        self._rng, sub = jax.random.split(self._rng)
+        """Claim a row: implant any cached prefix, lay out the prefill
+        region, and process the FIRST piece. Long prompts (chunked prefill)
+        leave the row in 'prefilling' state — subsequent pieces interleave
+        with decode chunks so admissions never stall in-flight rows."""
+        base, rest = 0, req.ids
         hit = self._lookup_prefix(req.ids)
-        gen_start = None
+        implanted = None
         if hit is not None:
             key, stored = hit
             n16 = len(key)
@@ -551,70 +570,95 @@ class LMEngine:
             # suffixes bucket at the 16-token prefix quantum, NOT the full
             # prefill buckets — padding a 4-token tail to a 128 bucket
             # would waste cache slots and blow the max_seq layout check
-            sbucket = ((len(suffix_ids) + 15) // 16) * 16
-            if n16 + sbucket + req.max_new_tokens <= self.max_seq:
-                # reuse: implant the prefix KV, prefill only the suffix
-                self.cache = self._implant(self.cache, stored, row)
-                suffix = np.full((1, sbucket), self.pad_id, np.int32)
-                suffix[0, : len(suffix_ids)] = suffix_ids
-                self.cache, tok, valid = self._suffix_prefill(
-                    self.cache,
-                    jnp.asarray(suffix),
-                    jnp.asarray([len(suffix_ids)], np.int32),
-                    n16,
-                    row,
-                    jnp.float32(req.temperature),
-                    sub,
-                )
-                gen_start = n16 + sbucket
-                self.stats["prefix_hits"] += 1
-                self.stats["prefix_tokens_reused"] += n16
-                # a hit can EXTEND the cache: the row now holds a longer
-                # contiguous real prefix than the entry that matched
-                self._store_prefix(req.ids, row)
-        if gen_start is None:
-            bucket = self._bucket(len(req.ids))
-            prompt = np.full((1, bucket), self.pad_id, np.int32)
-            prompt[0, : len(req.ids)] = req.ids
-            self.cache, tok, valid = self._prefill(
-                self.cache,
-                jnp.asarray(prompt),
-                jnp.asarray([len(req.ids)], np.int32),
-                row,
-                jnp.float32(req.temperature),
-                sub,
-            )
-            gen_start = bucket
-            if self._prefix_cache is not None:
-                self._store_prefix(req.ids, row)
-        bucket = gen_start
-        tok = int(tok)
-        req.row, req.gen_start = row, bucket
+            C = self.prefill_chunk or ((len(suffix_ids) + 15) // 16) * 16
+            n_pieces = -(-len(suffix_ids) // C)
+            if n16 + n_pieces * C + req.max_new_tokens <= self.max_seq:
+                implanted = (n16, stored, suffix_ids, C, n_pieces)
+        if implanted is not None:
+            n16, stored, rest, C, n_pieces = implanted
+            self.cache = self._implant(self.cache, stored, row)
+            base = n16
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_reused"] += n16
+        else:
+            # layout vs max_seq was already enforced by _enqueue (same
+            # formula) — no recheck needed here
+            C = self.prefill_chunk or self._bucket(len(rest))
+            n_pieces = -(-len(rest) // C)
+        gen_start = base + n_pieces * C
+        req.row, req.gen_start = row, gen_start
         self._slots[row] = req
         self.real_len[row] = len(req.ids)
-        self.gen_start[row] = bucket
+        self.gen_start[row] = gen_start
         self.gen_count[row] = 0
         self.budget[row] = req.max_new_tokens
         self.temp[row] = req.temperature
+        self.stats["admitted"] += 1
+        self.stats["max_concurrent"] = max(
+            self.stats["max_concurrent"], sum(s is not None for s in self._slots)
+        )
+        self._prefilling[row] = {
+            "req": req, "rest": rest, "base": base, "C": C,
+            "n_pieces": n_pieces, "piece": 0,
+        }
+        if n_pieces == 1:
+            # single-piece prompts admit synchronously (no interleaving to
+            # gain); multi-piece rows take ONE piece per loop iteration via
+            # _advance_prefills so decode chunks run between pieces
+            self._advance_prefill(row)
+
+    def _advance_prefill(self, row: int) -> None:
+        """Run ONE prefill piece for a prefilling row; the final piece
+        yields the first token and activates (or finishes) the request."""
+        st = self._prefilling[row]
+        req, rest, base, C = st["req"], st["rest"], st["base"], st["C"]
+        i = st["piece"]
+        final = i == st["n_pieces"] - 1
+        piece_ids = rest[i * C: (i + 1) * C]
+        piece = np.full((1, C), self.pad_id, np.int32)
+        piece[0, : len(piece_ids)] = piece_ids
+        self._rng, sub = jax.random.split(self._rng)
+        self.cache, tok, valid = self._suffix_prefill(
+            self.cache,
+            jnp.asarray(piece),
+            jnp.asarray([len(piece_ids)], np.int32),
+            base + i * C,
+            row,
+            jnp.float32(req.temperature),
+            sub,
+        )
+        self.stats["prefill_pieces"] += 1
+        st["piece"] = i + 1
+        if not final:
+            return  # tok is a throwaway sample from a non-final position
+        del self._prefilling[row]
+        if self._prefix_cache is not None:
+            self._store_prefix(req.ids, row)
+        tok = int(tok)
         if bool(valid):
             req.push([tok])
         self.last_tok[row] = tok
         # one-token completions (eos first, or budget 1) finish here
         finished = (not bool(valid)) or req.max_new_tokens <= 1
-        self.stats["admitted"] += 1
-        self.stats["max_concurrent"] = max(
-            self.stats["max_concurrent"], sum(s is not None for s in self._slots)
-        )
         if finished:
             self._finish(row)
         else:
             self.active[row] = True
             self.gen_count[row] = 1
 
+    def _advance_prefills(self) -> None:
+        for row in list(self._prefilling):
+            req = self._prefilling[row]["req"]
+            if req.cancelled.is_set():
+                self._finish(row)
+                continue
+            self._advance_prefill(row)
+
     def _finish(self, row: int) -> None:
         req = self._slots[row]
         self._slots[row] = None
         self.active[row] = False
+        self._prefilling.pop(row, None)
         if req is not None:
             # count BEFORE done.set(): callers may read/reset stats the
             # moment their submit returns (warmup does)
@@ -646,7 +690,10 @@ class LMEngine:
     def _loop_inner(self) -> None:
         while not self._stop.is_set():
             self._admit_all()
+            self._advance_prefills()  # one piece per prefilling row
             if not self.active.any():
+                if self._prefilling:
+                    continue  # keep advancing pieces, don't park
                 # idle: park until a submit arrives
                 self._work.wait(0.05)
                 self._work.clear()
@@ -740,7 +787,7 @@ class LMEngineModel(LMRuntimeModel):
     def __init__(
         self, name, storage_path=None, *, max_batch=8, max_seq=None,
         chunk_steps=8, prefix_cache_entries=0, prefix_cache_tokens=None,
-        mesh=None, rules=None, **kwargs,
+        prefill_chunk=None, mesh=None, rules=None, **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
@@ -749,6 +796,7 @@ class LMEngineModel(LMRuntimeModel):
         self._engine_prefix_tokens = prefix_cache_tokens
         self._engine_mesh = mesh
         self._engine_rules = rules
+        self._engine_prefill_chunk = prefill_chunk
         self._engine_max_seq = max_seq or (
             self.buckets.seq_lens[-1] + self.max_new_tokens
         )
@@ -782,6 +830,7 @@ class LMEngineModel(LMRuntimeModel):
             eos_id=self.eos_id,
             prefix_cache_entries=self._engine_prefix_entries,
             prefix_cache_tokens=self._engine_prefix_tokens,
+            prefill_chunk=self._engine_prefill_chunk,
             mesh=self._engine_mesh,
             rules=self._engine_rules,
         ).start()
@@ -828,9 +877,9 @@ class LMEngineModel(LMRuntimeModel):
                 # and afterwards one hit per n16 compiles its implant
                 sweep = (
                     range(16, self.buckets.seq_lens[-1] + 1, 16)
-                    if j == 0
+                    if j == 0 and eng.prefill_chunk is None
                     else (16,)
-                )
+                )  # with prefill_chunk, every piece is one shape — no sweep
                 for si, sbucket in enumerate(sweep):
                     slen = sbucket - 15
                     try:

@@ -784,3 +784,66 @@ def test_tp_sharded_engine_matches_unsharded():
     finally:
         plain.stop()
         sharded.stop()
+
+
+def test_chunked_prefill_parity_and_interleaving(model_and_params):
+    """prefill_chunk splits long prompts into pieces interleaved with
+    decode — and changes NOTHING about the tokens produced, even with a
+    concurrent request decoding mid-prefill."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=2, max_seq=128, chunk_steps=2,
+        prefill_buckets=(64,), eos_id=EOS, prefill_chunk=16,
+    ).start()
+    try:
+        rng = np.random.default_rng(41)
+        # single long prompt: 3 pieces (40 tokens / 16)
+        ids = [int(x) for x in rng.integers(2, CFG.vocab_size, size=40)]
+        got = eng.submit(ids, max_new_tokens=10)
+        assert eng.stats["prefill_pieces"] == 3
+        want = _reference_completion(model, params, ids, 10)
+        assert got == want, (got, want)
+
+        # a long admission arriving WHILE another row decodes: both match
+        long_ids = [int(x) for x in rng.integers(2, CFG.vocab_size, size=48)]
+        short_ids = [int(x) for x in rng.integers(2, CFG.vocab_size, size=6)]
+        results = {}
+
+        def run_short():
+            results["short"] = eng.submit(short_ids, max_new_tokens=16)
+
+        th = threading.Thread(target=run_short)
+        th.start()
+        time.sleep(0.02)  # short starts decoding first
+        results["long"] = eng.submit(long_ids, max_new_tokens=10)
+        th.join(120)
+    finally:
+        eng.stop()
+    assert results["short"] == _reference_completion(
+        model, params, short_ids, 16
+    )
+    assert results["long"] == _reference_completion(
+        model, params, long_ids, 10
+    )
+
+
+def test_chunked_prefill_with_prefix_cache(model_and_params):
+    """Chunked prefill composes with prefix caching: hit implants the
+    prefix, the suffix chunks, answers stay exact."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=1, max_seq=160, chunk_steps=2,
+        prefill_buckets=(64,), eos_id=EOS, prefill_chunk=16,
+        prefix_cache_entries=4,
+    ).start()
+    try:
+        rng = np.random.default_rng(43)
+        base = [int(x) for x in rng.integers(2, CFG.vocab_size, size=50)]
+        eng.submit(base, max_new_tokens=4)  # stores base[:48]
+        tail = [int(x) for x in rng.integers(2, CFG.vocab_size, size=20)]
+        ids = base[:48] + tail
+        got = eng.submit(ids, max_new_tokens=10)
+        assert eng.stats["prefix_hits"] == 1
+        assert got == _reference_completion(model, params, ids, 10)
+    finally:
+        eng.stop()
