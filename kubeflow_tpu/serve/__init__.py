@@ -23,6 +23,14 @@ Layout mirrors the reference's separation of concerns:
                    under one HBM budget with LRU load/unload and pinning.
 - ``generate``   — generative causal-LM runtime: KV-cache decode, whole
                    generation as one jitted prefill+scan program.
+- ``engine``     — continuous-batching LM engine (the vLLM analog):
+                   chunked scan decode, automatic prefix caching, chunked
+                   prefill, SSE streaming, load shedding, tensor-parallel
+                   serving; ``causal-lm-engine``/``vllm`` formats.
+- ``xgboost_runtime`` — first-party XGBoost JSON-checkpoint reader with a
+                   jitted lockstep tree walk (no xgboost dependency).
+- ``cloudstorage`` — http(s)/s3(SigV4)/gs wire clients with Range resume
+                   behind the storage-initializer scheme registry.
 - ``sklearn_runtime`` — pickled sklearn estimators (linear family on the
                    MXU, trees on host), exact linear ``:explain``.
 - ``graph``      — ``InferenceGraph`` sequence/switch/ensemble/splitter routing.
@@ -38,6 +46,11 @@ from kubeflow_tpu.serve.spec import (
 from kubeflow_tpu.serve.controller import InferenceServiceController
 from kubeflow_tpu.serve.composite import ComposedService
 from kubeflow_tpu.serve.modelmesh import MeshBackedModel, ModelMesh
+from kubeflow_tpu.serve.engine import (
+    EngineOverloaded,
+    LMEngine,
+    LMEngineModel,
+)
 
 __all__ = [
     "Model",
@@ -52,4 +65,7 @@ __all__ = [
     "ComposedService",
     "MeshBackedModel",
     "ModelMesh",
+    "LMEngine",
+    "LMEngineModel",
+    "EngineOverloaded",
 ]
