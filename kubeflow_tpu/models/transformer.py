@@ -270,30 +270,31 @@ def dispatch_attention(q, k, v, cfg: TransformerConfig, *, segment_ids=None):
 
     spec = P((Axis.DATA, Axis.FSDP), Axis.MODEL, Axis.SEQ, None)
     seg_spec = P((Axis.DATA, Axis.FSDP), Axis.SEQ)
+    # unpacked batches must not pay the seg machinery (per-tile mask loads,
+    # an extra ring ppermute per hop, the ulysses all_gather): the dummy
+    # zeros below exist only to give shard_map a concrete operand
+    has_seg = segment_ids is not None
 
     if cfg.attn_impl == "flash":
         def local(q, k, v, seg):
+            seg = seg if has_seg else None
             return flash_attention(
                 q, k, v,
                 q_segment_ids=seg, kv_segment_ids=seg, **kw,
             )
     elif cfg.attn_impl == "ring":
-        if segment_ids is not None:
-            raise NotImplementedError("ring attention with segment ids")
         def local(q, k, v, seg):
-            del seg
             return ring_attention_local(
                 q, k, v, axis_name=Axis.SEQ, causal=cfg.causal,
+                segment_ids=seg if has_seg else None,
                 block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
                 interpret=cfg.interpret_kernels,
             )
     else:  # ulysses
-        if segment_ids is not None:
-            raise NotImplementedError("ulysses attention with segment ids")
         def local(q, k, v, seg):
-            del seg
             return ulysses_attention_local(
                 q, k, v, axis_name=Axis.SEQ, causal=cfg.causal,
+                segment_ids=seg if has_seg else None,
                 block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
                 interpret=cfg.interpret_kernels,
             )

@@ -39,6 +39,12 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
+def float0_zeros(seg):
+    """Symbolic-zero cotangent for an integer segment-id array (or None) —
+    the one convention every seg-carrying custom_vjp shares."""
+    return None if seg is None else np.zeros(seg.shape, jax.dtypes.float0)
+
+
 def _attn_kernel(
     q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
     out_ref, lse_ref,
@@ -510,8 +516,7 @@ def _flash_bwd(causal, scale, block_q, block_k_and_interp, res, dout):
     )
     dq, dk, dv = dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
     # integer segment ids carry symbolic-zero (float0) cotangents
-    zseg = lambda s: None if s is None else np.zeros(s.shape, jax.dtypes.float0)
-    return dq, dk, dv, zseg(q_seg), zseg(kv_seg)
+    return dq, dk, dv, float0_zeros(q_seg), float0_zeros(kv_seg)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -29,8 +29,18 @@ from kubeflow_tpu.ops.flash_attention import (
     NEG_INF,
     flash_attention,
     flash_attention_bwd,
+    float0_zeros,
     reference_attention,
 )
+
+
+def global_seg_operand(mesh, seg_spec, segment_ids, q):
+    """Shared wrapper plumbing: shard_map needs a concrete seg operand even
+    when the caller passed None — substitute zeros (ignored by the local fn
+    when has_seg is False) and place it on the seq-sharded layout."""
+    if segment_ids is None:
+        segment_ids = jnp.zeros(q.shape[:1] + q.shape[2:3], jnp.int32)
+    return jax.device_put(segment_ids, NamedSharding(mesh, seg_spec))
 
 
 def _rotate(x, axis_name: str):
@@ -39,8 +49,8 @@ def _rotate(x, axis_name: str):
     return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
 
 
-def _block_flash(q, k, v, *, step: int, src, me, causal, scale,
-                 block_q, block_k, interpret):
+def _block_flash(q, k, v, q_seg, kv_seg, *, step: int, src, me, causal,
+                 scale, block_q, block_k, interpret):
     """Partial attention of local q vs the kv shard currently held (from
     ring rank ``src``). Returns (out, lse).
 
@@ -51,9 +61,15 @@ def _block_flash(q, k, v, *, step: int, src, me, causal, scale,
     to a single flash kernel instead of tracing all three branches."""
     B, H, S, D = q.shape
 
+    seg_kw = (
+        {"q_segment_ids": q_seg, "kv_segment_ids": kv_seg}
+        if q_seg is not None
+        else {}
+    )
+
     def full(_):
         return flash_attention(
-            q, k, v, causal=False, scale=scale,
+            q, k, v, causal=False, scale=scale, **seg_kw,
             block_q=block_q, block_k=block_k,
             interpret=interpret, return_residuals=True,
         )
@@ -68,7 +84,7 @@ def _block_flash(q, k, v, *, step: int, src, me, causal, scale,
         return full(None)
     if step == 0:
         return flash_attention(
-            q, k, v, causal=True, scale=scale,
+            q, k, v, causal=True, scale=scale, **seg_kw,
             block_q=block_q, block_k=block_k,
             interpret=interpret, return_residuals=True,
         )
@@ -83,7 +99,10 @@ def _merge(o, lse, o_t, lse_t):
     return o * w + o_t * w_t.astype(o.dtype), lse_new
 
 
-def _ring_fwd_pass(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
+def _ring_fwd_pass(
+    q, k, v, q_seg, kv_seg, axis_name, causal, scale, block_q, block_k,
+    interpret,
+):
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, H, S, D = q.shape
@@ -92,13 +111,18 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale, block_q, block_k, interpre
     for step in range(n):
         src = (me - step) % n  # whose kv shard we currently hold
         o_t, lse_t = _block_flash(
-            q, k, v, step=step, src=src, me=me, causal=causal, scale=scale,
+            q, k, v, q_seg, kv_seg, step=step, src=src, me=me,
+            causal=causal, scale=scale,
             block_q=block_q, block_k=block_k, interpret=interpret,
         )
         o, lse = _merge(o, lse, o_t, lse_t)
         if step != n - 1:
             k = _rotate(k, axis_name)
             v = _rotate(v, axis_name)
+            if kv_seg is not None:
+                # the segment labels belong to the kv shard: they ride the
+                # same ring hop so masking stays aligned with the data
+                kv_seg = _rotate(kv_seg, axis_name)
     return o, lse
 
 
@@ -106,19 +130,23 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale, block_q, block_k, interpre
 # custom VJP (operates on LOCAL shards inside shard_map)
 # --------------------------------------------------------------------------- #
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_local(q, k, v, axis_name, causal, scale, blocks, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _ring_local(q, k, v, q_seg, kv_seg, axis_name, causal, scale, blocks,
+                interpret):
     o, _ = _ring_fwd_pass(
-        q, k, v, axis_name, causal, scale, blocks[0], blocks[1], interpret
+        q, k, v, q_seg, kv_seg, axis_name, causal, scale, blocks[0],
+        blocks[1], interpret
     )
     return o
 
 
-def _ring_local_fwd(q, k, v, axis_name, causal, scale, blocks, interpret):
+def _ring_local_fwd(q, k, v, q_seg, kv_seg, axis_name, causal, scale,
+                    blocks, interpret):
     o, lse = _ring_fwd_pass(
-        q, k, v, axis_name, causal, scale, blocks[0], blocks[1], interpret
+        q, k, v, q_seg, kv_seg, axis_name, causal, scale, blocks[0],
+        blocks[1], interpret
     )
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, q_seg, kv_seg, o, lse)
 
 
 def _ring_local_bwd(axis_name, causal, scale, blocks, interpret, res, do):
@@ -131,7 +159,7 @@ def _ring_local_bwd(axis_name, causal, scale, blocks, interpret, res, do):
     the forward; the whole-shard S×S matrix is never built.
     """
     block_q, block_k = blocks
-    q, k, v, o, lse = res
+    q, k, v, q_seg, kv_seg, o, lse = res
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
 
@@ -139,12 +167,19 @@ def _ring_local_bwd(axis_name, causal, scale, blocks, interpret, res, do):
     dk = jnp.zeros_like(k, dtype=jnp.float32)  # rides the ring with k,v
     dv = jnp.zeros_like(v, dtype=jnp.float32)
 
-    def hop(step, src, k, v):
+    def hop(step, src, k, v, kv_seg):
         # mirrors _block_flash's static structure: step 0 = diagonal,
         # later causal steps = traced full-vs-skip, non-causal = full
+        seg_kw = (
+            {"q_segment_ids": q_seg, "kv_segment_ids": kv_seg}
+            if q_seg is not None
+            else {}
+        )
+
         def bwd(hop_causal):
             return flash_attention_bwd(
                 q, k, v, o, lse, do, causal=hop_causal, scale=scale,
+                **seg_kw,
                 block_q=block_q, block_k=block_k, interpret=interpret,
             )
 
@@ -163,19 +198,24 @@ def _ring_local_bwd(axis_name, causal, scale, blocks, interpret, res, do):
 
     for step in range(n):
         src = (me - step) % n  # whose kv shard we currently hold
-        dq_t, dk_t, dv_t = hop(step, src, k, v)
+        dq_t, dk_t, dv_t = hop(step, src, k, v, kv_seg)
         dq = dq + dq_t
         dk = dk + dk_t
         dv = dv + dv_t
         if step != n - 1:
             k = _rotate(k, axis_name)
             v = _rotate(v, axis_name)
+            if kv_seg is not None:
+                kv_seg = _rotate(kv_seg, axis_name)
             dk = _rotate(dk, axis_name)
             dv = _rotate(dv, axis_name)
     # after n-1 hops the accumulators sit one hop short of home
     dk = _rotate(dk, axis_name)
     dv = _rotate(dv, axis_name)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (
+        dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+        float0_zeros(q_seg), float0_zeros(kv_seg),
+    )
 
 
 _ring_local.defvjp(_ring_local_fwd, _ring_local_bwd)
@@ -186,16 +226,21 @@ def ring_attention_local(
     axis_name: str = Axis.SEQ,
     causal: bool = False,
     scale: float | None = None,
+    segment_ids=None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
 ):
     """Ring attention on LOCAL seq shards — call inside shard_map where
-    ``axis_name`` is a mesh axis and q/k/v are (B, H, S_local, D)."""
+    ``axis_name`` is a mesh axis and q/k/v are (B, H, S_local, D).
+    ``segment_ids`` (B, S_local): packed-sequence block-diagonal masking —
+    the local labels mask q, and a rotating copy rides the ring with each
+    kv shard."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     return _ring_local(
-        q, k, v, axis_name, causal, scale, (block_q, block_k), interpret
+        q, k, v, segment_ids, segment_ids, axis_name, causal, scale,
+        (block_q, block_k), interpret
     )
 
 
@@ -204,22 +249,27 @@ def ring_attention(
     axis_name: str = Axis.SEQ,
     causal: bool = False,
     scale: float | None = None,
+    segment_ids=None,
     interpret: bool = False,
 ):
     """Global-array convenience wrapper: shards seq over ``axis_name``,
-    batch over data, heads over model."""
+    batch over data, heads over model; ``segment_ids`` (B, S) for packed
+    sequences shards with the seq axis."""
     spec = P(Axis.DATA, Axis.MODEL, axis_name, None)
+    seg_spec = P(Axis.DATA, axis_name)
+    has_seg = segment_ids is not None
 
-    def local(q, k, v):
+    def local(q, k, v, seg):
         return ring_attention_local(
             q, k, v, axis_name=axis_name, causal=causal,
-            scale=scale, interpret=interpret,
+            scale=scale, segment_ids=seg if has_seg else None,
+            interpret=interpret,
         )
 
     fn = jax.shard_map(
-        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        local, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, check_vma=False,
     )
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    return fn(q, k, v)
+    return fn(q, k, v, global_seg_operand(mesh, seg_spec, segment_ids, q))

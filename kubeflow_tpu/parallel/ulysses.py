@@ -11,11 +11,13 @@ per layer via config).
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp  # noqa: F401  (used via global_seg_operand path)
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.core.mesh import Axis
 from kubeflow_tpu.ops.flash_attention import flash_attention
+from kubeflow_tpu.parallel.ring_attention import global_seg_operand
 
 
 def ulysses_attention_local(
@@ -23,16 +25,27 @@ def ulysses_attention_local(
     axis_name: str = Axis.SEQ,
     causal: bool = False,
     scale: float | None = None,
+    segment_ids=None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
 ):
     """Inside shard_map: q/k/v are (B, H, S_local, D); H must divide the
-    axis size. Returns (B, H, S_local, D)."""
+    axis size. ``segment_ids`` (B, S_local) gives packed-sequence
+    block-diagonal masking. Returns (B, H, S_local, D)."""
+    seg_kw = {}
+    if segment_ids is not None:
+        # after the all_to_all each rank attends over the FULL sequence, so
+        # it needs the full segment vector — a (B, S) int gather, cheap
+        # next to the qkv all_to_alls
+        full_seg = lax.all_gather(
+            segment_ids, axis_name, axis=1, tiled=True
+        )
+        seg_kw = {"q_segment_ids": full_seg, "kv_segment_ids": full_seg}
     n = lax.axis_size(axis_name)
     if n == 1:
         return flash_attention(
-            q, k, v, causal=causal, scale=scale,
+            q, k, v, causal=causal, scale=scale, **seg_kw,
             block_q=block_q, block_k=block_k, interpret=interpret,
         )
     H = q.shape[1]
@@ -50,7 +63,7 @@ def ulysses_attention_local(
 
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     o = flash_attention(
-        q, k, v, causal=causal, scale=scale,
+        q, k, v, causal=causal, scale=scale, **seg_kw,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return heads_to_seq(o)
@@ -61,22 +74,27 @@ def ulysses_attention(
     axis_name: str = Axis.SEQ,
     causal: bool = False,
     scale: float | None = None,
+    segment_ids=None,
     interpret: bool = False,
 ):
     """Global-array convenience wrapper (batch over data, heads over model,
-    seq over ``axis_name``)."""
+    seq over ``axis_name``); ``segment_ids`` (B, S) for packed sequences
+    shards with the seq axis."""
     spec = P(Axis.DATA, Axis.MODEL, axis_name, None)
+    seg_spec = P(Axis.DATA, axis_name)
+    has_seg = segment_ids is not None
 
-    def local(q, k, v):
+    def local(q, k, v, seg):
         return ulysses_attention_local(
             q, k, v, axis_name=axis_name, causal=causal,
-            scale=scale, interpret=interpret,
+            scale=scale, segment_ids=seg if has_seg else None,
+            interpret=interpret,
         )
 
     fn = jax.shard_map(
-        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        local, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, check_vma=False,
     )
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    return fn(q, k, v)
+    return fn(q, k, v, global_seg_operand(mesh, seg_spec, segment_ids, q))
