@@ -46,6 +46,9 @@ class TransformerConfig:
     d_model: int = 256
     n_layers: int = 4
     n_heads: int = 8
+    #: GQA: number of key/value heads (None = n_heads, i.e. plain MHA).
+    #: Shrinks the KV cache — the serving memory bill — by n_heads/kv.
+    n_kv_heads: int | None = None
     d_ff: int = 1024
     max_seq_len: int = 2048
     causal: bool = True              # False → bidirectional encoder (BERT)
@@ -72,10 +75,23 @@ class TransformerConfig:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        # explicit None check: `or` would silently turn an invalid 0 into
+        # full MHA instead of letting validate() reject it
+        return self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+
     def validate(self) -> None:
         if self.attn_impl not in ATTN_IMPLS:
             raise ValueError(
                 f"attn_impl {self.attn_impl!r} not in {ATTN_IMPLS}"
+            )
+        if self.n_kv_heads is not None and self.n_kv_heads < 1:
+            raise ValueError(f"n_kv_heads must be >= 1, got {self.n_kv_heads}")
+        if self.n_heads % self.kv_heads:
+            raise ValueError(
+                f"n_heads {self.n_heads} must be a multiple of n_kv_heads "
+                f"{self.kv_heads}"
             )
         if self.attn_impl == "ring" and not self.use_rope and self.causal:
             pass  # fine; just unusual
@@ -165,14 +181,22 @@ class Attention(nn.Module):
         cfg = self.cfg
         B, S, _ = x.shape
         H, D = cfg.n_heads, cfg.head_dim
-        dense = lambda name: nn.Dense(
-            H * D, use_bias=False, dtype=cfg.dtype, name=name
+        Hkv = cfg.kv_heads
+        groups = H // Hkv
+        dense = lambda name, nh: nn.Dense(
+            nh * D, use_bias=False, dtype=cfg.dtype, name=name
         )
-        q = dense("q_proj")(x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
-        k = dense("k_proj")(x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
-        v = dense("v_proj")(x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        q = dense("q_proj", H)(x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        k = dense("k_proj", Hkv)(x).reshape(B, S, Hkv, D).transpose(0, 2, 1, 3)
+        v = dense("v_proj", Hkv)(x).reshape(B, S, Hkv, D).transpose(0, 2, 1, 3)
         if cfg.use_rope:
             q, k = rope(q, positions), rope(k, positions)
+        # GQA: the CACHE and projections hold Hkv heads (the memory bill);
+        # attention itself sees the repeated view
+        expand = (
+            (lambda t: jnp.repeat(t, groups, axis=1)) if groups > 1
+            else (lambda t: t)
+        )
 
         new_cache = None
         if layer_cache is not None:
@@ -219,19 +243,27 @@ class Attention(nn.Module):
             else:
                 mask = jnp.broadcast_to(kv_mask[:, None, :], (B, S, T))
             scale = 1.0 / jnp.sqrt(jnp.float32(D))
+            # grouped form: q reshaped (B, Hkv, g, S, D) against the
+            # Hkv-head cache — the repeated n_heads view of the whole
+            # max_len cache is never materialized (it would be a 2x-of-
+            # the-cache transient on EVERY decode step)
+            qg = q.reshape(B, Hkv, groups, S, D)
             scores = (
                 jnp.einsum(
-                    "bhsd,bhtd->bhst",
-                    q.astype(jnp.float32),
+                    "bhgsd,bhtd->bhgst",
+                    qg.astype(jnp.float32),
                     K.astype(jnp.float32),
                 )
                 * scale
             )
-            scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+            scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
-            o = jnp.einsum("bhst,bhtd->bhsd", probs, V)
+            o = jnp.einsum("bhgst,bhtd->bhgsd", probs, V)
+            o = o.reshape(B, H, S, D)
         else:
-            o = dispatch_attention(q, k, v, cfg, segment_ids=segment_ids)
+            o = dispatch_attention(
+                q, expand(k), expand(v), cfg, segment_ids=segment_ids
+            )
 
         o = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
         out = nn.Dense(
@@ -459,9 +491,10 @@ class TransformerLM(nn.Module):
 def init_kv_cache(
     cfg: TransformerConfig, batch: int, max_len: int, dtype: Any | None = None
 ) -> dict:
-    """Zeroed decode cache: one (B, H, max_len, head_dim) K and V per layer."""
+    """Zeroed decode cache: one (B, kv_heads, max_len, head_dim) K and V per
+    layer — GQA configs pay for kv_heads, not n_heads."""
     dtype = dtype or cfg.dtype
-    shape = (batch, cfg.n_heads, max_len, cfg.head_dim)
+    shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
     return {
         f"layers_{i}": {
             "k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)

@@ -257,8 +257,8 @@ class LMEngine:
         return cache, tok, tok != self.eos_id
 
     def _implant_impl(self, cache, stored, row):
-        """Copy a stored prefix's KV (1, H, n16, D per layer) into the
-        FRONT of cache row ``row``."""
+        """Copy a stored prefix's KV (1, kv_heads, n16, D per layer) into
+        the FRONT of cache row ``row``."""
         return {
             name: {
                 "k": jax.lax.dynamic_update_slice(
@@ -276,7 +276,8 @@ class LMEngine:
         16-multiple quantization bounds this set)."""
         fn = self._extract_jits.get(n16)
         if fn is None:
-            H, D = self.cfg.n_heads, self.cfg.head_dim
+            # the cache holds kv_heads (GQA), NOT n_heads
+            H, D = self.cfg.kv_heads, self.cfg.head_dim
 
             def impl(cache, row):
                 return {

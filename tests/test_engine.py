@@ -847,3 +847,78 @@ def test_chunked_prefill_with_prefix_cache(model_and_params):
         assert got == _reference_completion(model, params, ids, 10)
     finally:
         eng.stop()
+
+
+def test_engine_with_gqa_model(model_and_params):
+    """The engine serves a GQA config (half-size KV cache) with tokens
+    equal to the whole-batch generate path."""
+    del model_and_params  # GQA needs its own config/params
+    cfg = TransformerConfig(
+        vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, causal=True, max_seq_len=256, attn_impl="reference",
+        dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    eng = LMEngine(
+        model, cfg, params, max_batch=2, max_seq=64, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    try:
+        assert next(iter(eng.cache.values()))["k"].shape[1] == 2
+        gen = jax.jit(
+            make_generate_fn(model, cfg, max_new_tokens=10, eos_id=EOS)
+        )
+        rng = np.random.default_rng(47)
+        for _ in range(3):
+            ids = [int(x) for x in rng.integers(2, 89, size=rng.integers(4, 20))]
+            prompt = np.zeros((1, 32), np.int32)
+            prompt[0, : len(ids)] = ids
+            toks, n_valid = gen(
+                params, prompt, np.asarray([len(ids)], np.int32),
+                jax.random.PRNGKey(7), np.zeros((1,), np.float32),
+            )
+            want = [int(t) for t in np.asarray(toks)[0, : int(n_valid[0])]]
+            assert eng.submit(ids, max_new_tokens=10) == want
+    finally:
+        eng.stop()
+
+
+def test_engine_gqa_with_prefix_cache(model_and_params):
+    """Prefix caching must extract/implant at the GQA cache's kv_heads
+    width (regression: it sliced with n_heads and crashed)."""
+    del model_and_params
+    cfg = TransformerConfig(
+        vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, causal=True, max_seq_len=256, attn_impl="reference",
+        dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    eng = LMEngine(
+        model, cfg, params, max_batch=1, max_seq=96, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS, prefix_cache_entries=2,
+    ).start()
+    try:
+        rng = np.random.default_rng(53)
+        base = [int(x) for x in rng.integers(2, 89, size=20)]
+        first = eng.submit(base, max_new_tokens=6)
+        second = eng.submit(base[:16] + [7, 8], max_new_tokens=6)
+        assert eng.stats["prefix_hits"] == 1
+        gen = jax.jit(
+            make_generate_fn(model, cfg, max_new_tokens=6, eos_id=EOS)
+        )
+        for ids, got in ((base, first), (base[:16] + [7, 8], second)):
+            prompt = np.zeros((1, 32), np.int32)
+            prompt[0, : len(ids)] = ids
+            toks, n_valid = gen(
+                params, prompt, np.asarray([len(ids)], np.int32),
+                jax.random.PRNGKey(7), np.zeros((1,), np.float32),
+            )
+            assert got == [int(t) for t in np.asarray(toks)[0, : int(n_valid[0])]]
+    finally:
+        eng.stop()

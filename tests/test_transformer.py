@@ -177,3 +177,85 @@ def test_embed_onehot_matches_gather(ref_setup, tokens):
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(ref_logits), atol=1e-5
     )
+
+
+# ----------------------- grouped-query attention ----------------------- #
+
+def test_gqa_equals_mha_with_tied_kv_groups():
+    """A GQA model must equal an MHA model whose k/v kernels tie each
+    group of query heads to one shared kv head — GQA is a weight-sharing
+    pattern, not new math."""
+    import numpy as np
+
+    cfg_gqa = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, causal=True, attn_impl="reference", dtype=jnp.float32,
+    )
+    cfg_mha = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+        d_ff=64, causal=True, attn_impl="reference", dtype=jnp.float32,
+    )
+    m_gqa, m_mha = TransformerLM(cfg_gqa), TransformerLM(cfg_mha)
+    p_gqa = m_gqa.init(jax.random.PRNGKey(5), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+    D = cfg_gqa.head_dim
+    groups = cfg_gqa.n_heads // cfg_gqa.kv_heads
+
+    def tie(kernel):  # (d_model, Hkv*D) → (d_model, H*D), group-shared
+        cols = [kernel[:, g * D:(g + 1) * D] for g in range(cfg_gqa.kv_heads)]
+        return jnp.concatenate(
+            [cols[j // groups] for j in range(cfg_gqa.n_heads)], axis=1
+        )
+
+    p_mha = jax.tree_util.tree_map(lambda x: x, p_gqa)  # copy structure
+    p_mha = jax.device_get(p_mha)
+    for layer in [k for k in p_mha if k.startswith("layers_")]:
+        attn = p_mha[layer]["attn"]
+        attn["k_proj"]["kernel"] = tie(attn["k_proj"]["kernel"])
+        attn["v_proj"]["kernel"] = tie(attn["v_proj"]["kernel"])
+
+    toks = jax.random.randint(jax.random.PRNGKey(7), (2, 12), 0, 64)
+    out_gqa = m_gqa.apply({"params": p_gqa}, toks)
+    out_mha = m_mha.apply({"params": p_mha}, toks)
+    np.testing.assert_allclose(
+        np.asarray(out_gqa), np.asarray(out_mha), rtol=2e-5, atol=1e-5
+    )
+
+
+def test_gqa_cache_decode_matches_full_forward():
+    """KV-cache decode with GQA: the cache holds kv_heads (half the memory
+    here), and teacher-forced decode logits equal the full forward."""
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import init_kv_cache
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, causal=True, attn_impl="reference", dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+    B, S, P, MAX = 2, 12, 7, 16
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, 64)
+    full = model.apply({"params": params}, toks)
+    cache = init_kv_cache(cfg, B, MAX)
+    assert next(iter(cache.values()))["k"].shape[1] == 2  # kv_heads, not 4
+    lg, cache = model.apply(
+        {"params": params}, toks[:, :P], cache=cache, cache_index=0
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(full[:, :P]), rtol=2e-5, atol=1e-5
+    )
+    for t in range(P, S):
+        kv_mask = jnp.broadcast_to(jnp.arange(MAX) <= t, (B, MAX))
+        lg, cache = model.apply(
+            {"params": params}, toks[:, t:t + 1],
+            cache=cache, cache_index=t, kv_mask=kv_mask,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]),
+            rtol=2e-5, atol=1e-5, err_msg=f"gqa decode step {t}",
+        )
