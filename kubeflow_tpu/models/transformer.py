@@ -55,6 +55,9 @@ class TransformerConfig:
     use_rope: bool = True            # False → learned positions (BERT)
     dtype: Any = jnp.float32         # activation/compute dtype (bf16 on TPU)
     attn_impl: str = "flash"
+    #: sliding-window attention (requires causal; flash/reference impls):
+    #: each position attends to the previous ``attn_window`` tokens only
+    attn_window: int | None = None
     attn_block_q: int = 128
     attn_block_k: int = 128
     interpret_kernels: bool = False  # Pallas interpret mode (CPU tests)
@@ -86,6 +89,15 @@ class TransformerConfig:
             raise ValueError(
                 f"attn_impl {self.attn_impl!r} not in {ATTN_IMPLS}"
             )
+        if self.attn_window is not None:
+            if not self.causal:
+                raise ValueError("attn_window requires causal=True")
+            if self.attn_impl not in ("flash", "reference"):
+                raise ValueError(
+                    "attn_window supports attn_impl 'flash'/'reference' "
+                    f"(got {self.attn_impl!r}); window + context parallelism "
+                    "is not implemented"
+                )
         if self.n_kv_heads is not None and self.n_kv_heads < 1:
             raise ValueError(f"n_kv_heads must be >= 1, got {self.n_kv_heads}")
         if self.n_heads % self.kv_heads:
@@ -288,12 +300,12 @@ def dispatch_attention(q, k, v, cfg: TransformerConfig, *, segment_ids=None):
     ):
         if cfg.attn_impl == "reference":
             return reference_attention(
-                q, k, v, causal=cfg.causal,
+                q, k, v, causal=cfg.causal, window=cfg.attn_window,
                 q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
             )
         return flash_attention(
             q, k, v, q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
-            **kw,
+            window=cfg.attn_window, **kw,
         )
     if mesh.empty:
         raise ValueError(
@@ -311,7 +323,7 @@ def dispatch_attention(q, k, v, cfg: TransformerConfig, *, segment_ids=None):
         def local(q, k, v, seg):
             seg = seg if has_seg else None
             return flash_attention(
-                q, k, v,
+                q, k, v, window=cfg.attn_window,
                 q_segment_ids=seg, kv_segment_ids=seg, **kw,
             )
     elif cfg.attn_impl == "ring":

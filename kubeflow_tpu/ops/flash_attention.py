@@ -52,6 +52,7 @@ def _attn_kernel(
     *,
     scale: float,
     causal: bool,
+    window: int | None,
     block_q: int,
     block_k: int,
     num_k_blocks: int,
@@ -65,10 +66,13 @@ def _attn_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: skip kv blocks strictly in the future of this q block.
+    # Causal: skip kv blocks strictly in the future of this q block;
+    # window: also skip blocks entirely below the attention band.
     q_start = iq * block_q
     k_start = ik * block_k
     run = (k_start <= q_start + block_q - 1) if causal else True
+    if window is not None:
+        run = run & (k_start + block_k - 1 >= q_start - window + 1)
 
     @pl.when(run)
     def _body():
@@ -86,8 +90,8 @@ def _attn_kernel(
         ) * scale  # (Bq, Bk) f32
 
         mask = _tile_mask(
-            iq, ik, causal=causal, block_q=block_q, block_k=block_k,
-            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+            iq, ik, causal=causal, window=window, block_q=block_q,
+            block_k=block_k, qseg_ref=qseg_ref, kseg_ref=kseg_ref,
         )
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
@@ -112,7 +116,7 @@ def _attn_kernel(
 
 def _flash_forward(
     q, k, v, q_segment_ids, kv_segment_ids,
-    *, causal, scale, block_q, block_k, interpret,
+    *, causal, scale, block_q, block_k, interpret, window=None,
 ):
     batch, heads, sq, d = q.shape
     _, _, skv, _ = k.shape
@@ -129,6 +133,7 @@ def _flash_forward(
         _attn_kernel,
         scale=scale,
         causal=causal,
+        window=window,
         block_q=block_q,
         block_k=block_k,
         num_k_blocks=nk,
@@ -195,15 +200,22 @@ def _flash_forward(
 # peak live memory stays O(block_q × block_k) — never S×S.
 
 
-def _tile_mask(iq, ik, *, causal, block_q, block_k, qseg_ref, kseg_ref):
+def _tile_mask(iq, ik, *, causal, window, block_q, block_k, qseg_ref,
+               kseg_ref):
     """(mask or None) for the (block_q, block_k) tile at (iq, ik) — the ONE
-    place the causal/segment tile masking lives; forward and backward
-    kernels must agree or gradients silently diverge."""
+    place the causal/segment/window tile masking lives; forward and
+    backward kernels must agree or gradients silently diverge."""
     mask = None
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        mask = (iq * block_q + rows) >= (ik * block_k + cols)
+        qpos = iq * block_q + rows
+        kpos = ik * block_k + cols
+        mask = qpos >= kpos
+        if window is not None:
+            # sliding window: query attends to keys in
+            # [qpos - window + 1, qpos] (Mistral-style local attention)
+            mask = mask & (qpos - kpos < window)
     if qseg_ref is not None:
         qs = qseg_ref[0, 0]  # (Bq,)
         ks = kseg_ref[0, 0]  # (Bk,)
@@ -235,6 +247,7 @@ def _bwd_dq_kernel(
     *,
     scale: float,
     causal: bool,
+    window: int | None,
     block_q: int,
     block_k: int,
     num_k_blocks: int,
@@ -247,6 +260,10 @@ def _bwd_dq_kernel(
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+    if window is not None:
+        run = run & (
+            ik * block_k + block_k - 1 >= iq * block_q - window + 1
+        )
 
     @pl.when(run)
     def _body():
@@ -260,8 +277,8 @@ def _bwd_dq_kernel(
         lse = lse_ref[0, 0]                    # (Bq, 1)
         delta = delta_ref[0, 0]                # (Bq, 1)
         mask = _tile_mask(
-            iq, ik, causal=causal, block_q=block_q, block_k=block_k,
-            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+            iq, ik, causal=causal, window=window, block_q=block_q,
+            block_k=block_k, qseg_ref=qseg_ref, kseg_ref=kseg_ref,
         )
         p = _prob_block(q, k, lse, mask, scale=scale)
         dp = jax.lax.dot_general(
@@ -284,6 +301,7 @@ def _bwd_dkv_kernel(
     *,
     scale: float,
     causal: bool,
+    window: int | None,
     block_q: int,
     block_k: int,
     num_q_blocks: int,
@@ -296,8 +314,13 @@ def _bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # Causal: q blocks strictly before this kv block contribute nothing.
+    # Causal: q blocks strictly before this kv block contribute nothing;
+    # window: q blocks entirely above the band contribute nothing either.
     run = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+    if window is not None:
+        run = run & (
+            ik * block_k + block_k - 1 >= iq * block_q - window + 1
+        )
 
     @pl.when(run)
     def _body():
@@ -309,8 +332,8 @@ def _bwd_dkv_kernel(
         lse = lse_ref[0, 0]                    # (Bq, 1)
         delta = delta_ref[0, 0]                # (Bq, 1)
         mask = _tile_mask(
-            iq, ik, causal=causal, block_q=block_q, block_k=block_k,
-            qseg_ref=qseg_ref, kseg_ref=kseg_ref,
+            iq, ik, causal=causal, window=window, block_q=block_q,
+            block_k=block_k, qseg_ref=qseg_ref, kseg_ref=kseg_ref,
         )
         p = _prob_block(q, k, lse, mask, scale=scale)
         # dv += pᵀ · do
@@ -349,6 +372,7 @@ def flash_attention_bwd(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
     accum_dtype=jnp.float32,
+    window: int | None = None,
 ):
     """Flash-attention gradients from saved residuals, fully blockwise.
 
@@ -413,7 +437,7 @@ def flash_attention_bwd(
     # ---- dq ----
     dq_impl = functools.partial(
         _bwd_dq_kernel,
-        scale=scale, causal=causal,
+        scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_k_blocks=nk,
     )
     if has_seg:
@@ -441,7 +465,7 @@ def flash_attention_bwd(
     # ---- dk / dv ----
     dkv_impl = functools.partial(
         _bwd_dkv_kernel,
-        scale=scale, causal=causal,
+        scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_q_blocks=nq,
     )
     if has_seg:
@@ -486,31 +510,31 @@ def flash_attention_bwd(
     jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
 )
 def _flash(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k_and_interp):
-    block_k, interpret = block_k_and_interp
+    block_k, interpret, window = block_k_and_interp
     out, _ = _flash_forward(
         q, k, v, q_seg, kv_seg,
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, window=window,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return out
 
 
 def _flash_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k_and_interp):
-    block_k, interpret = block_k_and_interp
+    block_k, interpret, window = block_k_and_interp
     out, lse = _flash_forward(
         q, k, v, q_seg, kv_seg,
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, window=window,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k_and_interp, res, dout):
-    block_k, interpret = block_k_and_interp
+    block_k, interpret, window = block_k_and_interp
     q, k, v, q_seg, kv_seg, out, lse = res
     dq, dk, dv = flash_attention_bwd(
         q, k, v, out, lse, dout,
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, window=window,
         q_segment_ids=q_seg, kv_segment_ids=kv_seg,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
@@ -522,12 +546,16 @@ def _flash_bwd(causal, scale, block_q, block_k_and_interp, res, dout):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _full_mask(q_shape, k_shape, q_seg, kv_seg, causal):
+def _full_mask(q_shape, k_shape, q_seg, kv_seg, causal, window=None):
     _, _, sq, _ = q_shape
     _, _, skv, _ = k_shape
     mask = None
     if causal:
         mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)[None, None]
+        if window is not None:
+            qpos = jnp.arange(sq)[:, None] + (skv - sq)
+            kpos = jnp.arange(skv)[None, :]
+            mask = mask & ((qpos - kpos) < window)[None, None]
     if q_seg is not None:
         seg = (q_seg[:, None, :, None] == kv_seg[:, None, None, :])
         mask = seg if mask is None else (mask & seg)
@@ -547,8 +575,13 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
     return_residuals: bool = False,
+    window: int | None = None,
 ):
     """Fused attention. Shapes: q (B,H,Sq,D); k/v (B,H,Skv,D).
+
+    ``window`` (requires ``causal``): sliding-window attention — each
+    query sees keys in [qpos - window + 1, qpos]; out-of-band tiles are
+    skipped entirely, so compute is O(S·window) not O(S²).
 
     ``return_residuals`` additionally returns (lse,) — the per-row
     log-sum-exp — for cross-block merging (ring attention). Differentiable
@@ -561,24 +594,28 @@ def flash_attention(
         )
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError("pass both q_segment_ids and kv_segment_ids or neither")
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} needs causal=True and window >= 1"
+        )
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if return_residuals:
         out, lse = _flash_forward(
             q, k, v, q_segment_ids, kv_segment_ids,
-            causal=causal, scale=scale,
+            causal=causal, scale=scale, window=window,
             block_q=block_q, block_k=block_k, interpret=interpret,
         )
         return out, lse
     return _flash(
         q, k, v, q_segment_ids, kv_segment_ids,
-        causal, scale, block_q, (block_k, interpret),
+        causal, scale, block_q, (block_k, interpret, window),
     )
 
 
 def reference_attention(
     q, k, v, *, causal=False, scale=None,
-    q_segment_ids=None, kv_segment_ids=None,
+    q_segment_ids=None, kv_segment_ids=None, window=None,
 ):
     """Plain-XLA attention; numerics oracle for the kernels and the
     small-shape fallback."""
@@ -588,7 +625,9 @@ def reference_attention(
         "bhqd,bhkd->bhqk",
         q.astype(jnp.float32), k.astype(jnp.float32),
     ) * scale
-    mask = _full_mask(q.shape, k.shape, q_segment_ids, kv_segment_ids, causal)
+    mask = _full_mask(
+        q.shape, k.shape, q_segment_ids, kv_segment_ids, causal, window
+    )
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
