@@ -90,6 +90,10 @@ class TransformerConfig:
                 f"attn_impl {self.attn_impl!r} not in {ATTN_IMPLS}"
             )
         if self.attn_window is not None:
+            if self.attn_window < 1:
+                raise ValueError(
+                    f"attn_window must be >= 1, got {self.attn_window}"
+                )
             if not self.causal:
                 raise ValueError("attn_window requires causal=True")
             if self.attn_impl not in ("flash", "reference"):
@@ -244,15 +248,29 @@ class Attention(nn.Module):
             T = K.shape[2]
             kpos = jnp.arange(T)
             if kv_mask is None:
-                # default: plain causal over absolute slots (prefill)
+                # default: causal over absolute slots (prefill) — here slot
+                # index == token position, so the sliding window (if any)
+                # applies directly: key slot must be within the last
+                # attn_window positions of the query
                 if getattr(cache_index, "ndim", 0) == 1:
                     qpos = cache_index[:, None] + jnp.arange(S)[None, :]
                     mask = kpos[None, None, :] <= qpos[:, :, None]  # (B,S,T)
+                    if cfg.attn_window is not None:
+                        mask &= kpos[None, None, :] > (
+                            qpos[:, :, None] - cfg.attn_window
+                        )
                 else:
                     qpos = cache_index + jnp.arange(S)
-                    mask = (kpos[None, :] <= qpos[:, None])[None, :, :]
-                    mask = jnp.broadcast_to(mask, (B, S, T))
+                    mask = kpos[None, :] <= qpos[:, None]
+                    if cfg.attn_window is not None:
+                        mask &= kpos[None, :] > qpos[:, None] - cfg.attn_window
+                    mask = jnp.broadcast_to(mask[None, :, :], (B, S, T))
             else:
+                # caller-supplied slot mask: slot index need NOT equal token
+                # position (continuous-batching gen regions start at a
+                # quantized slot), so the window can only be applied by the
+                # caller, who owns the slot→position mapping. generate.py
+                # and serve/engine.py both do; anything else must too.
                 mask = jnp.broadcast_to(kv_mask[:, None, :], (B, S, T))
             scale = 1.0 / jnp.sqrt(jnp.float32(D))
             # grouped form: q reshaped (B, Hkv, g, S, D) against the

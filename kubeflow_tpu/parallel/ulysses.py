@@ -11,7 +11,6 @@ per layer via config).
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp  # noqa: F401  (used via global_seg_operand path)
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -119,7 +119,10 @@ def http_get_to_file(
                         return dest_path
                     # object changed size under us: start over CLEAN — the
                     # stale expected/etag belong to the previous version and
-                    # would fail the fresh download's own checks
+                    # would fail the fresh download's own checks. The
+                    # restart consumes this `for attempt` iteration, so an
+                    # object flapping between sizes exhausts the resume
+                    # budget and raises rather than looping forever.
                     os.remove(dest_path)
                     expected = etag = None
                     continue
@@ -204,10 +207,16 @@ def _sigv4_signer(region: str):
         headers["x-amz-content-sha256"] = "UNSIGNED-PAYLOAD"
         if token:
             headers["x-amz-security-token"] = token
+        # sort as (key, value) TUPLES after quoting, not joined "k=v"
+        # strings: '=' (0x3D) sorts above '-'/'.', so a key that is a
+        # prefix of another ("a" vs "a-b") would order differently than
+        # SigV4's key-then-value sort and 403
         canon_q = "&".join(
-            sorted(
-                "=".join(
-                    urllib.parse.quote(x, safe="-_.~") for x in (k, v)
+            f"{qk}={qv}"
+            for qk, qv in sorted(
+                (
+                    urllib.parse.quote(k, safe="-_.~"),
+                    urllib.parse.quote(v, safe="-_.~"),
                 )
                 for k, v in urllib.parse.parse_qsl(
                     p.query, keep_blank_values=True

@@ -44,7 +44,7 @@ from kubeflow_tpu.models.transformer import (
     TransformerLM,
     init_kv_cache,
 )
-from kubeflow_tpu.serve.generate import LMRuntimeModel
+from kubeflow_tpu.serve.generate import LMRuntimeModel, decode_kv_mask
 
 
 @dataclass
@@ -124,9 +124,19 @@ class LMEngine:
 
             rules = rules or transformer_rules(fsdp=False)
             specs = rules(params)
-            rules.validate_divisibility(
-                params, dict(zip(mesh.axis_names, mesh.devices.shape))
-            )
+            mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+            rules.validate_divisibility(params, mesh_shape)
+            # the KV cache shards its head axis P(None,'model',..) over
+            # kv_heads — validate_divisibility only sees PARAMS, so a GQA
+            # config with kv_heads % model-size != 0 would otherwise die
+            # later inside the jitted cache init with an opaque GSPMD error
+            model_size = mesh_shape.get("model", 1)
+            if cfg.kv_heads % model_size:
+                raise ValueError(
+                    f"TP serving shards the KV cache over kv_heads: "
+                    f"kv_heads {cfg.kv_heads} must be divisible by the "
+                    f"mesh 'model' axis size {model_size}"
+                )
             self.params = jax.tree_util.tree_map(
                 lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
                 params, specs,
@@ -313,11 +323,10 @@ class LMEngine:
             # gen_count-1): its KV lands at that slot, its rope position is
             # that absolute index, and attention sees everything up to it
             slot = gen_start + gen_count - 1      # (B,) per-row write slot
-            kv_mask = (kpos[None, :] < real_len[:, None]) | (
-                (kpos[None, :] >= gen_start[:, None])
-                & (kpos[None, :] <= slot[:, None])
-            )
             positions = (real_len + gen_count - 1)[:, None]
+            kv_mask = decode_kv_mask(
+                kpos, real_len, gen_start, slot, self.cfg.attn_window
+            )
             lg, cache = self.model.apply(
                 {"params": self.params},
                 tok[:, None],

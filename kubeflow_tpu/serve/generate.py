@@ -40,6 +40,32 @@ from kubeflow_tpu.models.transformer import (
 from kubeflow_tpu.serve.model import BucketSpec, Model
 
 
+def decode_kv_mask(kpos, prompt_len, gen_start, slot, window=None):
+    """(B, T) cache-slot mask for ONE decode step over a
+    ``[prompt | gap | gen]`` row layout: prompt slots ``[0, prompt_len)``
+    sit at their token positions; gen slot ``s`` in ``[gen_start, slot]``
+    holds token position ``prompt_len + (s - gen_start)`` (the gap between
+    ``prompt_len`` and ``gen_start`` is padding and never attended).
+
+    ``window`` applies sliding-window attention in TOKEN-POSITION space:
+    the query (at position ``prompt_len + slot - gen_start``) keeps keys
+    with position > query pos - window, which in the gen region reduces to
+    ``s > slot - window`` (row-independent). Shared by make_generate_fn and
+    LMEngine so the window math cannot diverge between them; scalars and
+    (B,) arrays both broadcast."""
+    pl = jnp.atleast_1d(jnp.asarray(prompt_len))[:, None]
+    gs = jnp.atleast_1d(jnp.asarray(gen_start))[:, None]
+    sl = jnp.atleast_1d(jnp.asarray(slot))[:, None]
+    k = kpos[None, :]
+    prompt_keep = k < pl
+    gen_keep = (k >= gs) & (k <= sl)
+    if window is not None:
+        qpos = pl + sl - gs
+        prompt_keep &= k > qpos - window
+        gen_keep &= k > sl - window
+    return prompt_keep | gen_keep
+
+
 def make_generate_fn(
     model: TransformerLM,
     cfg: TransformerConfig,
@@ -90,10 +116,10 @@ def make_generate_fn(
             slot = P + j  # cache slot for THIS token (same for all rows)
             # attend: real prompt slots + generated slots up to and incl.
             # this one; never pad slots, never unwritten slots
-            kv_mask = (kpos[None, :] < prompt_len[:, None]) | (
-                (kpos[None, :] >= P) & (kpos[None, :] <= slot)
-            )
             positions = (prompt_len + j)[:, None]  # rope continues per row
+            kv_mask = decode_kv_mask(
+                kpos, prompt_len, P, slot, cfg.attn_window
+            )
             lg, cache = model.apply(
                 {"params": params},
                 tok[:, None],

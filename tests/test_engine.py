@@ -922,3 +922,49 @@ def test_engine_gqa_with_prefix_cache(model_and_params):
             assert got == [int(t) for t in np.asarray(toks)[0, : int(n_valid[0])]]
     finally:
         eng.stop()
+
+
+def test_engine_with_sliding_window(model_and_params):
+    """A sliding-window model served through the engine must produce the
+    batch path's answers (which window via reference_attention) — exercises
+    the windowed chunk-decode kv_mask, the windowed suffix-prefill default
+    mask, and prefix reuse under a window."""
+    import dataclasses
+
+    wcfg = dataclasses.replace(CFG, attn_window=4)
+    model = TransformerLM(wcfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    gen = jax.jit(
+        make_generate_fn(model, wcfg, max_new_tokens=12, eos_id=EOS)
+    )
+
+    def want_for(ids):
+        prompt = np.zeros((1, 32), np.int32)
+        prompt[0, : len(ids)] = ids
+        toks, n_valid = gen(
+            params, prompt, np.asarray([len(ids)], np.int32),
+            jax.random.PRNGKey(7), np.zeros((1,), np.float32),
+        )
+        return [int(t) for t in np.asarray(toks)[0, : int(n_valid[0])]]
+
+    eng = LMEngine(
+        model, wcfg, params, max_batch=3, max_seq=64, chunk_steps=3,
+        prefill_buckets=(32,), eos_id=EOS, prefix_cache_entries=4,
+    ).start()
+    try:
+        rng = np.random.default_rng(5)
+        # prompts LONGER than the window so the boundary is live
+        prompts = [
+            [int(x) for x in rng.integers(2, CFG.vocab_size, size=n)]
+            for n in (6, 9, 17)
+        ]
+        for ids in prompts:
+            assert eng.submit(ids, max_new_tokens=12) == want_for(ids)
+        # resubmit the longest prompt: prefix reuse + windowed suffix prefill
+        before = eng.stats["prefix_hits"]
+        assert eng.submit(prompts[2], max_new_tokens=12) == want_for(prompts[2])
+        assert eng.stats["prefix_hits"] > before
+    finally:
+        eng.stop()

@@ -291,3 +291,71 @@ def test_lm_missing_storage_path_fails_closed(tmp_path, devices8):
     assert not m.ready
     # the probe must not have conjured the directory into existence
     assert not (tmp_path / "nope").exists()
+
+
+def test_windowed_cache_decode_matches_full_forward(devices8):
+    """Sliding-window models must serve the SAME windowed attention through
+    the KV-cache path: prefill + default-mask decode vs one full forward
+    (which routes through reference_attention's window). Regression for the
+    cached path silently using FULL attention when cfg.attn_window is set."""
+    cfg = _cfg(attn_window=4)
+    model = TransformerLM(cfg)
+    params = _params(model)
+    B, S, P, MAX = 2, 14, 6, 16
+    toks = jax.random.randint(jax.random.PRNGKey(3), (B, S), 0, cfg.vocab_size)
+    full = model.apply({"params": params}, toks)
+
+    cache = init_kv_cache(cfg, B, MAX)
+    lg, cache = model.apply(
+        {"params": params}, toks[:, :P], cache=cache, cache_index=0
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(full[:, :P]), rtol=2e-5, atol=1e-5
+    )
+    for t in range(P, S):
+        # default mask (kv_mask=None): the cached path must window itself
+        lg, cache = model.apply(
+            {"params": params}, toks[:, t : t + 1], cache=cache, cache_index=t
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full[:, t]),
+            rtol=2e-5, atol=1e-5, err_msg=f"windowed decode step {t}",
+        )
+
+
+def test_windowed_generation_matches_full_forward_loop(devices8):
+    """make_generate_fn with attn_window: the scan generator's windowed
+    kv_mask (prompt + gen regions) must equal naive generate-by-full-forward
+    — positions walk well past the window so the boundary is exercised."""
+    cfg = _cfg(attn_window=4)
+    model = TransformerLM(cfg)
+    params = _params(model)
+    max_new = 8
+    gen = jax.jit(
+        make_generate_fn(model, cfg, max_new_tokens=max_new, eos_id=63)
+    )
+    prompts = [[5, 9, 17], [3, 30, 41, 28, 11, 50, 2]]
+    P = 8
+    prompt = np.zeros((2, P), np.int32)
+    plen = np.zeros((2,), np.int32)
+    for i, p in enumerate(prompts):
+        prompt[i, : len(p)] = p
+        plen[i] = len(p)
+    out, n_valid = gen(
+        params, prompt, plen, jax.random.PRNGKey(0),
+        jnp.zeros((2,), jnp.float32),
+    )
+    out, n_valid = np.asarray(out), np.asarray(n_valid)
+    for i, p in enumerate(prompts):
+        seq = list(p)
+        for _ in range(max_new):
+            logits = model.apply(
+                {"params": params}, jnp.asarray([seq], jnp.int32)
+            )
+            nxt = int(jnp.argmax(logits[0, -1]))
+            if nxt == 63:
+                break
+            seq.append(nxt)
+        want = seq[len(p):]
+        got = [int(t) for t in out[i, : n_valid[i]]]
+        assert got == want, (i, got, want)
