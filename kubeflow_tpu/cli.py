@@ -215,6 +215,155 @@ def _cmd_serve(args) -> int:
     return 0
 
 
+def _pipeline_ir(path: str, name: str | None = None):
+    """A pipeline definition is either a .py file holding @pipeline objects
+    (compiled here — the `kfp.compiler` analog) or an already-compiled IR
+    JSON file (the portable wire format)."""
+    from kubeflow_tpu.pipelines.compiler import compile_pipeline
+    from kubeflow_tpu.pipelines.dsl import Pipeline
+    from kubeflow_tpu.pipelines.ir import PipelineIR
+
+    if path.endswith(".py"):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_kft_pipeline", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        pipes = [v for v in vars(mod).values() if isinstance(v, Pipeline)]
+        if name is not None:
+            pipes = [p for p in pipes if p.name == name]
+        if len(pipes) != 1:
+            raise SystemExit(
+                f"kft pipeline: {path} defines {len(pipes)} pipelines"
+                + (f" named {name!r}" if name else "")
+                + "; use --name to pick one"
+            )
+        return compile_pipeline(pipes[0])
+    with open(path) as f:
+        doc = json.load(f)
+    return PipelineIR.from_dict(doc.get("spec", doc))
+
+
+def _api(server: str, method: str, path: str, body: dict | None = None) -> dict:
+    import urllib.request
+
+    req = urllib.request.Request(
+        server.rstrip("/") + path,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:  # noqa: S310
+            return json.loads(resp.read())
+    except Exception as e:
+        import urllib.error
+
+        if isinstance(e, urllib.error.HTTPError):
+            raise SystemExit(
+                f"kft pipeline: {method} {path} → HTTP {e.code}: "
+                f"{e.read().decode(errors='replace')[:500]}"
+            ) from e
+        raise SystemExit(f"kft pipeline: cannot reach {server}: {e}") from e
+
+
+def _parse_params(pairs: list[str]) -> dict:
+    out = {}
+    for pair in pairs or []:
+        if "=" not in pair:
+            raise SystemExit(f"kft pipeline: -p expects key=value, got {pair!r}")
+        k, _, v = pair.partition("=")
+        try:
+            out[k] = json.loads(v)   # numbers/bools/json pass through typed
+        except json.JSONDecodeError:
+            out[k] = v
+    return out
+
+
+def _cmd_pipeline(args) -> int:
+    if args.action in ("compile", "upload") and not args.file:
+        raise SystemExit(f"kft pipeline {args.action}: -f is required")
+    if args.action == "run" and not args.server and not args.file:
+        raise SystemExit("kft pipeline run: -f is required without --server")
+    if args.action in ("upload", "list") and not args.server:
+        raise SystemExit(f"kft pipeline {args.action}: --server is required")
+    if args.action == "compile":
+        ir = _pipeline_ir(args.file, args.name)
+        text = json.dumps(ir.to_dict(), indent=1, sort_keys=True)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+        else:
+            print(text)
+        return 0
+
+    if args.action == "upload":
+        ir = _pipeline_ir(args.file, args.name)
+        out = _api(args.server, "POST", "/apis/v2beta1/pipelines",
+                   {"spec": ir.to_dict()})
+        print(f"pipeline/{out['name']}: uploaded ({out['tasks']} tasks)")
+        return 0
+
+    if args.action == "list":
+        out = _api(args.server, "GET", "/apis/v2beta1/pipelines")
+        for p in out["pipelines"]:
+            print(f"{p['name']}\ttasks={p['tasks']}\t{p['description']}")
+        runs = _api(args.server, "GET", "/apis/v2beta1/runs")["runs"]
+        for r in runs:
+            print(f"run/{r['run_id']}\t{r['pipeline']}\t{r['state']}")
+        return 0
+
+    # run
+    params = _parse_params(args.param)
+    if args.server:
+        if args.file:
+            body = {"spec": _pipeline_ir(args.file, args.name).to_dict()}
+        else:
+            if not args.name:
+                raise SystemExit("kft pipeline run: need -f or --name")
+            body = {"pipeline": args.name}
+        body["parameters"] = params
+        rid = _api(args.server, "POST", "/apis/v2beta1/runs", body)["run_id"]
+        deadline = time.monotonic() + args.timeout
+        while True:
+            rec = _api(args.server, "GET", f"/apis/v2beta1/runs/{rid}")
+            if rec["state"] not in ("PENDING", "RUNNING"):
+                break
+            if time.monotonic() > deadline:
+                print(f"run/{rid}: still {rec['state']} after "
+                      f"{args.timeout}s", file=sys.stderr)
+                return 1
+            time.sleep(0.2)
+    else:
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+        from kubeflow_tpu.pipelines.cache import StepCache
+        from kubeflow_tpu.pipelines.runner import PipelineRunner
+
+        ir = _pipeline_ir(args.file, args.name)
+        root = args.artifacts or tempfile.mkdtemp(prefix="kft-pipeline-")
+        runner = PipelineRunner(
+            artifact_store=ArtifactStore(os.path.join(root, "artifacts")),
+            cache=StepCache(os.path.join(root, "cache")),
+        )
+        res = runner.run(ir, params)
+        rec = {
+            "run_id": res.run_id, "state": res.state,
+            "tasks": {
+                n: {"state": t.state, "cache_hit": t.cache_hit,
+                    "error": t.error}
+                for n, t in res.tasks.items()
+            },
+        }
+    for name, t in rec["tasks"].items():
+        mark = " (cached)" if t.get("cache_hit") else ""
+        err = f" — {t['error']}" if t.get("error") else ""
+        print(f"  task/{name}: {t['state']}{mark}{err}")
+    if rec.get("error"):  # run-level failure (outside any task)
+        print(f"run error: {rec['error']}", file=sys.stderr)
+    print(f"run/{rec['run_id']}: {rec['state']}")
+    return 0 if rec["state"] == "SUCCEEDED" else 1
+
+
 def _cmd_doctor(args) -> int:
     from kubeflow_tpu.core.deviceprobe import UNREACHABLE, probe_backend
 
@@ -267,6 +416,26 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--port-file", default=None,
                    help="write the bound HTTP port here once listening")
     s.set_defaults(fn=_cmd_serve)
+
+    pl = sub.add_parser(
+        "pipeline", help="compile/upload/run pipelines (KFP-CLI analog)"
+    )
+    pl.add_argument("action",
+                    choices=("compile", "upload", "run", "list"))
+    pl.add_argument("-f", "--file", default=None,
+                    help="@pipeline .py file or compiled IR .json")
+    pl.add_argument("--name", default=None,
+                    help="pipeline name (pick from .py / server registry)")
+    pl.add_argument("-o", "--output", default=None,
+                    help="compile: write IR JSON here instead of stdout")
+    pl.add_argument("-p", "--param", action="append", default=[],
+                    help="run: pipeline parameter key=value (repeatable)")
+    pl.add_argument("--server", default=None,
+                    help="pipelines API base URL (default: run in-process)")
+    pl.add_argument("--artifacts", default=None,
+                    help="local run: artifact/cache root (default: tmpdir)")
+    pl.add_argument("--timeout", type=float, default=300.0)
+    pl.set_defaults(fn=_cmd_pipeline)
 
     d = sub.add_parser("doctor", help="accelerator liveness + inventory")
     d.add_argument("--timeout", type=float, default=120.0)

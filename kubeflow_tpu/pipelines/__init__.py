@@ -19,6 +19,7 @@ from kubeflow_tpu.pipelines.artifacts import (
     Metrics,
     Model,
 )
+from kubeflow_tpu.pipelines.api import PipelineAPIServer
 from kubeflow_tpu.pipelines.cache import StepCache
 from kubeflow_tpu.pipelines.compiler import compile_pipeline
 from kubeflow_tpu.pipelines.dsl import Input, Output, component, pipeline
@@ -37,6 +38,7 @@ __all__ = [
     "Metrics",
     "Model",
     "Output",
+    "PipelineAPIServer",
     "PipelineIR",
     "PipelineRunner",
     "RecurringRun",

@@ -105,8 +105,9 @@ class LineageStore:
         list — SURVEY.md §2.4 Frontend row)."""
         with self._lock:
             rows = self._db.execute(
+                # case-insensitive: the runner writes 'SUCCEEDED'/'FAILED'
                 "SELECT run_id, COUNT(*),"
-                " SUM(state='Succeeded'), SUM(state='Failed'),"
+                " SUM(UPPER(state)='SUCCEEDED'), SUM(UPPER(state)='FAILED'),"
                 " SUM(cache_hit), MIN(started), MAX(finished)"
                 " FROM executions GROUP BY run_id ORDER BY MIN(started) DESC"
             ).fetchall()

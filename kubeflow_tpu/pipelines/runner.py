@@ -71,6 +71,27 @@ class RunResult:
         return Artifact.from_dict(raw)
 
 
+def resolve_parameters(
+    ir: PipelineIR, parameters: dict[str, Any] | None
+) -> dict[str, Any]:
+    """Merge caller parameters over pipeline defaults, failing fast on
+    unknown names and on REQUIRED parameters left unset. Shared by the
+    in-process runner and the REST API so a bad request is rejected at
+    submit time, not inside the run thread."""
+    from kubeflow_tpu.pipelines.dsl import REQUIRED
+
+    params = {name: default for name, default in ir.parameters}
+    for k, v in (parameters or {}).items():
+        if k not in params:
+            raise KeyError(f"unknown pipeline parameter {k!r}")
+        params[k] = v
+    missing = [k for k, v in params.items()
+               if isinstance(v, str) and v == REQUIRED]
+    if missing:
+        raise ValueError(f"pipeline parameters without values: {missing}")
+    return params
+
+
 class PipelineRunner:
     def __init__(
         self,
@@ -92,22 +113,19 @@ class PipelineRunner:
     # ------------------------------------------------------------------ #
 
     def run(self, ir: PipelineIR, parameters: dict[str, Any] | None = None,
-            *, run_id: str | None = None) -> RunResult:
+            *, run_id: str | None = None,
+            live_tasks: dict[str, TaskResult] | None = None) -> RunResult:
+        """``live_tasks`` (optional): filled with the per-task TaskResult
+        objects AS THE RUN STARTS and mutated in place while it executes —
+        the REST API's GET /runs/{id} reads task states from it mid-run."""
         t0 = time.monotonic()
         run_id = run_id or uuid.uuid4().hex[:12]
-        from kubeflow_tpu.pipelines.dsl import REQUIRED
-        params = {name: default for name, default in ir.parameters}
-        for k, v in (parameters or {}).items():
-            if k not in params:
-                raise KeyError(f"unknown pipeline parameter {k!r}")
-            params[k] = v
-        missing = [k for k, v in params.items()
-                   if isinstance(v, str) and v == REQUIRED]
-        if missing:
-            raise ValueError(f"pipeline parameters without values: {missing}")
+        params = resolve_parameters(ir, parameters)
 
         ir.topological_order()            # validate DAG up front
         results = {t.name: TaskResult() for t in ir.tasks}
+        if live_tasks is not None:
+            live_tasks.update(results)
         remaining = {t.name: set(t.deps()) for t in ir.tasks}
         dependents: dict[str, list[str]] = {t.name: [] for t in ir.tasks}
         for t in ir.tasks:

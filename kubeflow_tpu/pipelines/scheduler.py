@@ -91,6 +91,10 @@ class RunScheduler:
         with self._lock:
             return self._schedules[uid]
 
+    def list(self) -> list[RecurringRun]:
+        with self._lock:
+            return list(self._schedules.values())
+
     # ------------------------------------------------------------------ #
 
     def start(self) -> "RunScheduler":

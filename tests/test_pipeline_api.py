@@ -1,0 +1,285 @@
+"""Pipelines REST API (pipelines/api.py): upload/run/watch over HTTP —
+the KFP API-server surface (SURVEY.md §2.4 API-server row), plus the
+`kft pipeline` CLI spellings of the same flows."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.pipelines import (
+    ArtifactStore,
+    Dataset,
+    Input,
+    LineageStore,
+    Output,
+    PipelineRunner,
+    StepCache,
+    compile_pipeline,
+    component,
+    pipeline,
+)
+from kubeflow_tpu.pipelines.api import PipelineAPIServer
+
+
+@component
+def produce(n: int, out: Output[Dataset]) -> None:
+    with open(out.path, "w") as f:
+        f.write(",".join(str(i) for i in range(n)))
+
+
+@component
+def consume(data: Input[Dataset], scale: int) -> int:
+    with open(data.path) as f:
+        return scale * sum(int(x) for x in f.read().split(","))
+
+
+@pipeline(name="api-pipeline", description="produce → consume")
+def api_pipeline(n: int = 4, scale: int = 1):
+    d = produce(n=n)
+    consume(data=d.output, scale=scale)
+
+
+@component
+def boom() -> int:
+    raise RuntimeError("kaboom")
+
+
+@pipeline(name="boom-pipeline")
+def boom_pipeline():
+    boom()
+
+
+def _req(method: str, url: str, body: dict | None = None) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def api(tmp_path):
+    lineage = LineageStore(str(tmp_path / "mlmd.db"))
+    runner = PipelineRunner(
+        artifact_store=ArtifactStore(str(tmp_path / "artifacts")),
+        cache=StepCache(str(tmp_path / "cache")),
+        lineage=lineage,
+    )
+    server = PipelineAPIServer(runner).start()
+    yield server, lineage
+    server.stop()
+
+
+def _wait_terminal(base: str, rid: str, timeout: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while True:
+        rec = _req("GET", f"{base}/apis/v2beta1/runs/{rid}")
+        if rec["state"] not in ("PENDING", "RUNNING"):
+            return rec
+        assert time.monotonic() < deadline, rec
+        time.sleep(0.05)
+
+
+def test_upload_run_watch_e2e(api):
+    """The VERDICT 'done' bar: submit a pipeline and watch a run over
+    HTTP end to end."""
+    server, lineage = api
+    base = server.url
+    ir = compile_pipeline(api_pipeline)
+
+    up = _req("POST", f"{base}/apis/v2beta1/pipelines", {"spec": ir.to_dict()})
+    assert up["name"] == "api-pipeline" and up["tasks"] == 2
+
+    listed = _req("GET", f"{base}/apis/v2beta1/pipelines")["pipelines"]
+    assert [p["name"] for p in listed] == ["api-pipeline"]
+    got = _req("GET", f"{base}/apis/v2beta1/pipelines/api-pipeline")
+    assert got["spec"]["name"] == "api-pipeline"
+
+    run = _req(
+        "POST", f"{base}/apis/v2beta1/runs",
+        {"pipeline": "api-pipeline", "parameters": {"n": 3, "scale": 10}},
+    )
+    rec = _wait_terminal(base, run["run_id"])
+    assert rec["state"] == "SUCCEEDED", rec
+    assert rec["tasks"]["produce"]["state"] == "SUCCEEDED"
+    assert rec["tasks"]["consume"]["state"] == "SUCCEEDED"
+    assert rec["parameters"] == {"n": 3, "scale": 10}
+
+    runs = _req("GET", f"{base}/apis/v2beta1/runs")["runs"]
+    assert runs[0]["run_id"] == run["run_id"]
+
+    # the dashboard's read-only pipelines tab shares this LineageStore:
+    # a run submitted over the API is visible there — with the right
+    # terminal state (regression: the rollup once matched 'Succeeded'
+    # while the runner writes 'SUCCEEDED', showing every run as Running)
+    dash = {r["run_id"]: r for r in lineage.runs()}
+    assert dash[run["run_id"]]["state"] == "Succeeded"
+    assert dash[run["run_id"]]["succeeded"] == 2
+
+    # a failing pipeline reports FAILED with the task error
+    _req("POST", f"{base}/apis/v2beta1/pipelines",
+         {"spec": compile_pipeline(boom_pipeline).to_dict()})
+    run2 = _req("POST", f"{base}/apis/v2beta1/runs",
+                {"pipeline": "boom-pipeline"})
+    rec2 = _wait_terminal(base, run2["run_id"])
+    assert rec2["state"] == "FAILED"
+    assert "kaboom" in rec2["tasks"]["boom"]["error"]
+
+    deleted = _req("DELETE", f"{base}/apis/v2beta1/pipelines/api-pipeline")
+    assert deleted["deleted"] == "api-pipeline"
+
+
+def test_api_error_contract(api):
+    server, _ = api
+    base = server.url
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req("POST", f"{base}/apis/v2beta1/runs", {"pipeline": "nope"})
+    assert e.value.code == 404
+
+    ir = compile_pipeline(api_pipeline)
+    _req("POST", f"{base}/apis/v2beta1/pipelines", {"spec": ir.to_dict()})
+    # unknown parameter rejected AT SUBMIT (not inside the run thread)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req("POST", f"{base}/apis/v2beta1/runs",
+             {"pipeline": "api-pipeline", "parameters": {"bogus": 1}})
+    assert e.value.code == 404  # KeyError contract: unknown name
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req("GET", f"{base}/apis/v2beta1/runs/deadbeef")
+    assert e.value.code == 404
+
+    # a cyclic spec is rejected at upload AND at inline-run submit
+    bad = ir.to_dict()
+    bad["tasks"][0]["after"] = [bad["tasks"][1]["name"]]
+    bad["tasks"][1]["after"] = [bad["tasks"][0]["name"]]
+    for path, body in (
+        ("/apis/v2beta1/pipelines", {"spec": bad}),
+        ("/apis/v2beta1/runs", {"spec": bad}),
+        ("/apis/v2beta1/recurringruns", {"spec": bad, "interval_s": 1}),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req("POST", f"{base}{path}", body)
+        assert e.value.code == 400, path
+
+    # malformed requests (missing fields) are 400, not 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req("POST", f"{base}/apis/v2beta1/runs", {"parameters": {}})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req("POST", f"{base}/apis/v2beta1/recurringruns",
+             {"spec": ir.to_dict()})
+    assert e.value.code == 400
+
+
+def test_recurring_crud_over_http(api):
+    server, _ = api
+    base = server.url
+    ir = compile_pipeline(api_pipeline)
+
+    rr = _req(
+        "POST", f"{base}/apis/v2beta1/recurringruns",
+        {"spec": ir.to_dict(), "interval_s": 0.1, "max_runs": 2,
+         "parameters": {"n": 2}},
+    )
+    uid = rr["uid"]
+    deadline = time.monotonic() + 60
+    while True:
+        got = _req("GET", f"{base}/apis/v2beta1/recurringruns/{uid}")
+        if got["fired"] >= 2 and len(got["history"]) >= 2:
+            break
+        assert time.monotonic() < deadline, got
+        time.sleep(0.05)
+    assert all(h["state"] == "SUCCEEDED" for h in got["history"])
+
+    _req("POST", f"{base}/apis/v2beta1/recurringruns/{uid}:pause")
+    assert _req("GET", f"{base}/apis/v2beta1/recurringruns/{uid}")["paused"]
+    _req("POST", f"{base}/apis/v2beta1/recurringruns/{uid}:resume")
+    assert not _req("GET", f"{base}/apis/v2beta1/recurringruns/{uid}")["paused"]
+
+    listed = _req("GET", f"{base}/apis/v2beta1/recurringruns")
+    assert [r["uid"] for r in listed["recurring_runs"]] == [uid]
+    _req("DELETE", f"{base}/apis/v2beta1/recurringruns/{uid}")
+    with pytest.raises(urllib.error.HTTPError):
+        _req("GET", f"{base}/apis/v2beta1/recurringruns/{uid}")
+
+
+def test_inline_spec_run(api):
+    """`kft pipeline run -f` one-shot path: no upload, spec inline."""
+    server, _ = api
+    base = server.url
+    ir = compile_pipeline(api_pipeline)
+    run = _req("POST", f"{base}/apis/v2beta1/runs",
+               {"spec": ir.to_dict(), "parameters": {"n": 2}})
+    rec = _wait_terminal(base, run["run_id"])
+    assert rec["state"] == "SUCCEEDED"
+
+
+PIPELINE_PY = '''
+from kubeflow_tpu.pipelines import component, pipeline
+
+@component
+def double(x: int) -> int:
+    return 2 * x
+
+@component
+def inc(x: int) -> int:
+    return x + 1
+
+@pipeline(name="cli-pipeline")
+def cli_pipeline(x: int = 3):
+    d = double(x=x)
+    inc(x=d.output)
+'''
+
+
+def test_cli_compile_and_local_run(tmp_path, capsys):
+    from kubeflow_tpu.cli import main
+
+    src = tmp_path / "pipe.py"
+    src.write_text(PIPELINE_PY)
+    out_json = tmp_path / "pipe.json"
+    assert main(["pipeline", "compile", "-f", str(src),
+                 "-o", str(out_json)]) == 0
+    ir_doc = json.loads(out_json.read_text())
+    assert ir_doc["name"] == "cli-pipeline"
+
+    # local in-process run from the COMPILED artifact, param override
+    rc = main(["pipeline", "run", "-f", str(out_json), "-p", "x=5",
+               "--artifacts", str(tmp_path / "work")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "task/double: SUCCEEDED" in out
+    assert ": SUCCEEDED" in out.splitlines()[-1]
+
+
+def test_cli_run_without_file_is_usage_error(capsys):
+    from kubeflow_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="-f is required without"):
+        main(["pipeline", "run", "--name", "foo"])
+
+
+def test_cli_upload_and_server_run(tmp_path, api, capsys):
+    from kubeflow_tpu.cli import main
+
+    server, _ = api
+    src = tmp_path / "pipe.py"
+    src.write_text(PIPELINE_PY)
+    assert main(["pipeline", "upload", "-f", str(src),
+                 "--server", server.url]) == 0
+    rc = main(["pipeline", "run", "--name", "cli-pipeline",
+               "--server", server.url, "-p", "x=4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "uploaded" in out and "SUCCEEDED" in out
+    assert main(["pipeline", "list", "--server", server.url]) == 0
+    assert "cli-pipeline" in capsys.readouterr().out
