@@ -74,7 +74,7 @@ def _cmd_run(args) -> int:
     for doc in _load_docs(args.file):
         try:
             parsed = manifests.parse(doc)
-        except ValueError:
+        except manifests.UnsupportedKind:
             # kubectl semantics: apply what we know, note what we skip
             print(
                 f"kft run: skipping unsupported kind "
@@ -82,6 +82,10 @@ def _cmd_run(args) -> int:
                 file=sys.stderr,
             )
             continue
+        except ValueError as e:  # supported kind, broken manifest: surface
+            print(f"kft run: invalid {doc.get('kind')} manifest: {e}",
+                  file=sys.stderr)
+            return 2
         if isinstance(parsed, JobSpec):
             jobs.append(parsed)
         elif isinstance(parsed, ExperimentSpec):
@@ -151,24 +155,33 @@ def _cmd_serve(args) -> int:
 
     from kubeflow_tpu.platform import manifests
     from kubeflow_tpu.serve import storage
+    from kubeflow_tpu.serve.graph import GraphSpec
     from kubeflow_tpu.serve.runtimes import default_registry
     from kubeflow_tpu.serve.server import ModelServer
     from kubeflow_tpu.serve.spec import InferenceServiceSpec
 
     specs = []
+    graphs: list[GraphSpec] = []
     for doc in _load_docs(args.file):
         try:
             parsed = manifests.parse(doc)
-        except ValueError:
+        except manifests.UnsupportedKind:
             print(
                 f"kft serve: skipping unsupported kind {doc.get('kind')!r}",
                 file=sys.stderr,
             )
             continue
+        except ValueError as e:  # supported kind, broken manifest: surface
+            print(f"kft serve: invalid {doc.get('kind')} manifest: {e}",
+                  file=sys.stderr)
+            return 2
         if isinstance(parsed, InferenceServiceSpec):
             specs.append(parsed)
-    if not specs:
-        print("kft serve: no InferenceService manifests found", file=sys.stderr)
+        elif isinstance(parsed, GraphSpec):
+            graphs.append(parsed)
+    if not specs and not graphs:
+        print("kft serve: no InferenceService/InferenceGraph manifests found",
+              file=sys.stderr)
         return 2
 
     registry = default_registry()
@@ -188,6 +201,13 @@ def _cmd_serve(args) -> int:
         model = rt.factory(spec.name, local)
         server.register(model)
         print(f"inferenceservice/{spec.name}: loaded ({rt.name})")
+    for g in graphs:  # after models: build validates every serviceName
+        try:
+            server.register_graph(g)
+        except ValueError as e:
+            print(f"kft serve: inferencegraph/{g.name}: {e}", file=sys.stderr)
+            return 2
+        print(f"inferencegraph/{g.name}: routing {sorted(g.services())}")
 
     async def main() -> None:
         await server.start_async()

@@ -223,6 +223,13 @@ def build(source: str | Mapping[str, Any], base_dir: str | None = None) -> list[
 # --------------------------------------------------------------------------- #
 
 #: kinds → parser returning a typed spec this framework can submit
+class UnsupportedKind(ValueError):
+    """The manifest's ``kind`` has no parser here. Distinct from a
+    malformed manifest OF a supported kind — CLI callers skip the former
+    (kubectl semantics) but must SURFACE the latter, or an operator's
+    typo'd graph/service silently vanishes from the deployment."""
+
+
 def parse(manifest: Mapping[str, Any]) -> Any:
     kind = manifest.get("kind", "")
     if kind in ("JAXJob", "PyTorchJob", "TFJob", "MPIJob", "XGBoostJob",
@@ -234,6 +241,10 @@ def parse(manifest: Mapping[str, Any]) -> Any:
         from kubeflow_tpu.serve.spec import InferenceServiceSpec
 
         return InferenceServiceSpec.from_manifest(manifest)
+    if kind == "InferenceGraph":
+        from kubeflow_tpu.serve.graph import GraphSpec
+
+        return GraphSpec.from_manifest(manifest)
     if kind == "Experiment":
         from kubeflow_tpu.tune.spec import ExperimentSpec
 
@@ -243,7 +254,7 @@ def parse(manifest: Mapping[str, Any]) -> Any:
         )
     if kind == "ConfigMap":
         return dict(manifest)
-    raise ValueError(f"no parser for manifest kind {kind!r}")
+    raise UnsupportedKind(f"no parser for manifest kind {kind!r}")
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -73,6 +73,9 @@ class DataPlane:
             raise web.HTTPNotFound(reason=f"model '{name}' not found")
         return self._models[name]
 
+    def has(self, name: str) -> bool:
+        return name in self._models
+
     def list_models(self) -> list[str]:
         return sorted(self._models)
 
@@ -146,6 +149,7 @@ class ModelServer:
         self.grpc_port = grpc_port
         self.dataplane = DataPlane(logger=logger)
         self._batcher_cfg = batcher
+        self._graphs: dict[str, Any] = {}  # name → InferenceGraph
         for m in models or []:
             self.register(m)
         self._runner: web.AppRunner | None = None
@@ -155,6 +159,12 @@ class ModelServer:
         if not model.ready:
             model.load()
         self.dataplane.register(model, self._batcher_cfg)
+
+    def register_graph(self, spec) -> None:
+        """Materialize a ``GraphSpec`` over this server's dataplane —
+        every serviceName must already be registered (admission check).
+        Served at ``POST /v1/graphs/{name}:infer``."""
+        self._graphs[spec.name] = spec.build(self.dataplane)
 
     # -- app ----------------------------------------------------------------
 
@@ -181,7 +191,27 @@ class ModelServer:
         app.router.add_post(
             "/v2/models/{name}/generate_stream", self._v2_generate_stream
         )
+        # InferenceGraph routing plane ([kserve] cmd/router analog)
+        app.router.add_get(
+            "/v1/graphs",
+            lambda r: web.json_response({"graphs": sorted(self._graphs)}),
+        )
+        app.router.add_post("/v1/graphs/{name}:infer", self._graph_infer)
         return app
+
+    async def _graph_infer(self, req: web.Request) -> web.Response:
+        name = req.match_info["name"]
+        if name not in self._graphs:
+            raise web.HTTPNotFound(reason=f"graph '{name}' not found")
+        try:
+            payload = await req.json()
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        try:
+            out = await self._graphs[name].infer(payload)
+        except ValueError as e:  # e.g. switch with no matching branch
+            raise web.HTTPBadRequest(reason=str(e))
+        return web.json_response(out)
 
     async def _v2_generate(self, req: web.Request) -> web.Response:
         name = req.match_info["name"]

@@ -163,11 +163,24 @@ def test_serve_subprocess_answers_rest(tmp_path):
             "storageUri": f"file://{model_src}",
         }}},
     }
+    graph = {
+        "apiVersion": "serving.kserve.io/v1alpha1",
+        "kind": "InferenceGraph",
+        "metadata": {"name": "g"},
+        "spec": {"nodes": {"root": {
+            "routerType": "Sequence",
+            "steps": [{"serviceName": "gbt"}],
+        }}},
+    }
+    manifest = tmp_path / "m.yaml"
+    manifest.write_text(
+        yaml.safe_dump(isvc) + "---\n" + yaml.safe_dump(graph)
+    )
     port_file = tmp_path / "port"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu", "serve",
-         "-f", _write_yaml(tmp_path, isvc),
+         "-f", str(manifest),
          "--http-port", "0", "--port-file", str(port_file),
          "--model-dir", str(tmp_path / "mnt")],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -183,6 +196,15 @@ def test_serve_subprocess_answers_rest(tmp_path):
         port = int(port_file.read_text())
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/v1/models/gbt:predict",
+            data=json.dumps({"instances": [[0.0], [2.0]]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["predictions"] == [1.0, -3.0]
+        # the InferenceGraph doc in the same manifest serves too
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/graphs/g:infer",
             data=json.dumps({"instances": [[0.0], [2.0]]}).encode(),
             headers={"Content-Type": "application/json"},
         )
