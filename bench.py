@@ -850,17 +850,14 @@ def bench_engine() -> dict:
     ]
     budgets = [max_new] * 16
 
-    eng = LMEngine(
-        model, cfg, params, max_batch=8, max_seq=192, chunk_steps=8,
-        prefill_buckets=(128,), eos_id=1,
-    ).start()
-    try:
-        for _ in range(2):  # compile prefill + chunk
-            eng.submit(requests[0][:16], max_new_tokens=8)
+    def run_fanout(e) -> tuple[float, int, dict[int, list[int]]]:
+        """The 16-way concurrent workload, timed: wall seconds, total
+        tokens, per-request outputs. Shared by the dense and paged phases
+        so both measure the identical protocol."""
         outs: dict[int, list[int]] = {}
 
         def worker(i):
-            outs[i] = eng.submit(requests[i], max_new_tokens=budgets[i])
+            outs[i] = e.submit(requests[i], max_new_tokens=budgets[i])
 
         t0 = time.perf_counter()
         threads = [
@@ -870,8 +867,20 @@ def bench_engine() -> dict:
             t.start()
         for t in threads:
             t.join(600)
-        t_engine = time.perf_counter() - t0
-        engine_tokens = sum(len(v) for v in outs.values())
+        return (
+            time.perf_counter() - t0,
+            sum(len(v) for v in outs.values()),
+            outs,
+        )
+
+    eng = LMEngine(
+        model, cfg, params, max_batch=8, max_seq=192, chunk_steps=8,
+        prefill_buckets=(128,), eos_id=1,
+    ).start()
+    try:
+        for _ in range(2):  # compile prefill + chunk
+            eng.submit(requests[0][:16], max_new_tokens=8)
+        t_engine, engine_tokens, _ = run_fanout(eng)
     finally:
         eng.stop()
 
@@ -924,6 +933,33 @@ def bench_engine() -> dict:
     t_nocache = run_shared(0)
     t_cache = run_shared(8)
 
+    # phase 3: paged-KV HBM density (serve/paging.py, the vLLM block-table
+    # analog). An engine provisioned for 512-token context serves the same
+    # 16 concurrent mixed-length requests out of a 2624-token page pool —
+    # the dense layout bills 16 x 512 = 8192 cache tokens for the identical
+    # workload. All 16 rows must be RESIDENT AT ONCE for the density claim.
+    paged_max_seq, pool_tokens = 512, 64 * 41  # 40 usable pages + scratch
+    pe = LMEngine(
+        model, cfg, params, max_batch=16, max_seq=paged_max_seq,
+        chunk_steps=8, prefill_buckets=(128,), eos_id=1,
+        kv_pool_tokens=pool_tokens, page_size=64,
+    ).start()
+    try:
+        # warm BOTH ends: the longest request at full budget walks the
+        # large pages_w chunk widths, and a short low-budget one compiles
+        # the pages_w=1 program (reachable late in the run when only short
+        # rows remain active) — so no compile lands in the timed window
+        longest = max(range(16), key=lambda i: len(requests[i]))
+        pe.submit(requests[longest], max_new_tokens=max_new)
+        pe.submit(requests[0][:16], max_new_tokens=8)
+        t_paged, paged_tokens, _ = run_fanout(pe)
+        paged_concurrent = pe.stats["max_concurrent"]
+        pages_peak = pe.stats.get("pages_used_peak", 0)
+    finally:
+        pe.stop()
+    paged_tok_per_s = paged_tokens / t_paged if t_paged > 0 else float("nan")
+    dense_rectangle = 16 * paged_max_seq
+
     return {
         "metric": "engine_concurrent_throughput",
         "value": round(tok_per_s, 1),
@@ -951,6 +987,21 @@ def bench_engine() -> dict:
                 "through the whole-batch generate path (a server without "
                 "continuous batching under concurrent load)"
             ),
+            "paged_kv": {
+                "hbm_density_x": round(dense_rectangle / pool_tokens, 2),
+                "dense_cache_tokens": dense_rectangle,
+                "pool_tokens": pool_tokens,
+                "pages_used_peak": pages_peak,
+                "page_size": 64,
+                "max_concurrent": paged_concurrent,
+                "all_resident": paged_concurrent == 16,
+                "tokens_per_s": round(paged_tok_per_s, 1),
+                "workload": (
+                    "same 16 concurrent requests, engine provisioned for "
+                    "512-token context: dense bills 16x512 cache tokens, "
+                    "the page pool holds 2624"
+                ),
+            },
         },
     }
 

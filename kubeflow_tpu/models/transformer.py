@@ -181,6 +181,30 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(x.dtype)
 
 
+def _grouped_cache_attention(q, K, V, mask, groups):
+    """Cache-side attention in grouped (GQA) form: q (B, H, S, D) against
+    an Hkv-head cache view K/V (B, Hkv, T, D) with mask (B, S, T). q is
+    reshaped (B, Hkv, g, S, D) so the repeated n_heads view of the whole
+    cache is never materialized (it would be a 2x-of-the-cache transient
+    on EVERY decode step)."""
+    B, H, S, D = q.shape
+    Hkv = K.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+    qg = q.reshape(B, Hkv, groups, S, D)
+    scores = (
+        jnp.einsum(
+            "bhgsd,bhtd->bhgst",
+            qg.astype(jnp.float32),
+            K.astype(jnp.float32),
+        )
+        * scale
+    )
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
+    o = jnp.einsum("bhgst,bhtd->bhgsd", probs, V)
+    return o.reshape(B, H, S, D)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -193,6 +217,9 @@ class Attention(nn.Module):
         layer_cache=None,
         cache_index=None,
         kv_mask=None,
+        page_table=None,
+        page_size=None,
+        page_write_ok=None,
     ):
         cfg = self.cfg
         B, S, _ = x.shape
@@ -215,7 +242,51 @@ class Attention(nn.Module):
         )
 
         new_cache = None
-        if layer_cache is not None:
+        if page_table is not None:
+            # PAGED decode/prefill (serve/paging.py): the cache is one flat
+            # token axis per layer — (Hkv, pool_tokens, D) — and row b's
+            # logical token j lives at table[b, j//P]*P + j%P. Because a
+            # row's token space is CONTIGUOUS (no quantized gen gap), the
+            # causal + sliding-window mask is just arithmetic on positions;
+            # no kv_mask operand exists in this mode.
+            P = page_size
+            n_pages_w = page_table.shape[1]
+            W = n_pages_w * P
+            # scatter this call's keys/values into the pool. Pad positions
+            # and dead rows route to the scratch page (0) via page_write_ok.
+            wpage = jnp.take_along_axis(page_table, positions // P, axis=1)
+            flat_w = wpage * P + positions % P                    # (B, S)
+            if page_write_ok is not None:
+                # scratch slots: distinct per (b,s) within the page where
+                # possible, but collisions are harmless — never read
+                scratch = (
+                    jnp.arange(B * S, dtype=flat_w.dtype).reshape(B, S) % P
+                )
+                flat_w = jnp.where(page_write_ok, flat_w, scratch)
+            idx = flat_w.reshape(-1)
+            K = layer_cache["k"].at[:, idx, :].set(
+                k.astype(layer_cache["k"].dtype)
+                .transpose(1, 0, 2, 3).reshape(Hkv, B * S, D)
+            )
+            V = layer_cache["v"].at[:, idx, :].set(
+                v.astype(layer_cache["v"].dtype)
+                .transpose(1, 0, 2, 3).reshape(Hkv, B * S, D)
+            )
+            new_cache = {"k": K, "v": V}
+            # gather each row's first W logical tokens back out
+            j = jnp.arange(W)
+            flat_r = (
+                page_table[:, j // P] * P + (j % P)[None, :]
+            ).reshape(-1)                                          # (B*W,)
+            Kg = K[:, flat_r, :].reshape(Hkv, B, W, D).transpose(1, 0, 2, 3)
+            Vg = V[:, flat_r, :].reshape(Hkv, B, W, D).transpose(1, 0, 2, 3)
+            mask = j[None, None, :] <= positions[:, :, None]       # (B,S,W)
+            if cfg.attn_window is not None:
+                mask &= j[None, None, :] > (
+                    positions[:, :, None] - cfg.attn_window
+                )
+            o = _grouped_cache_attention(q, Kg, Vg, mask, groups)
+        elif layer_cache is not None:
             # Autoregressive decode path (SURVEY.md §2.2 "vLLM backend"
             # analog): keys/values accumulate in an explicit functional
             # cache — (B, H, max_len, D) — threaded through apply(), never
@@ -272,24 +343,7 @@ class Attention(nn.Module):
                 # caller, who owns the slot→position mapping. generate.py
                 # and serve/engine.py both do; anything else must too.
                 mask = jnp.broadcast_to(kv_mask[:, None, :], (B, S, T))
-            scale = 1.0 / jnp.sqrt(jnp.float32(D))
-            # grouped form: q reshaped (B, Hkv, g, S, D) against the
-            # Hkv-head cache — the repeated n_heads view of the whole
-            # max_len cache is never materialized (it would be a 2x-of-
-            # the-cache transient on EVERY decode step)
-            qg = q.reshape(B, Hkv, groups, S, D)
-            scores = (
-                jnp.einsum(
-                    "bhgsd,bhtd->bhgst",
-                    qg.astype(jnp.float32),
-                    K.astype(jnp.float32),
-                )
-                * scale
-            )
-            scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
-            o = jnp.einsum("bhgst,bhtd->bhgsd", probs, V)
-            o = o.reshape(B, H, S, D)
+            o = _grouped_cache_attention(q, K, V, mask, groups)
         else:
             o = dispatch_attention(
                 q, expand(k), expand(v), cfg, segment_ids=segment_ids
@@ -429,6 +483,9 @@ class Block(nn.Module):
         layer_cache=None,
         cache_index=None,
         kv_mask=None,
+        page_table=None,
+        page_size=None,
+        page_write_ok=None,
     ):
         cfg = self.cfg
         new_cache = None
@@ -437,7 +494,8 @@ class Block(nn.Module):
             h, new_cache = Attention(cfg, name="attn")(
                 attn_in, positions, segment_ids,
                 layer_cache=layer_cache, cache_index=cache_index,
-                kv_mask=kv_mask,
+                kv_mask=kv_mask, page_table=page_table,
+                page_size=page_size, page_write_ok=page_write_ok,
             )
         else:
             h = Attention(cfg, name="attn")(attn_in, positions, segment_ids)
@@ -470,12 +528,19 @@ class TransformerLM(nn.Module):
         cache=None,
         cache_index=None,
         kv_mask=None,
+        page_table=None,
+        page_size=None,
+        page_write_ok=None,
     ):
         """Training/scoring: ``(tokens) -> logits``. Autoregressive serving:
         pass ``cache`` (from :func:`init_kv_cache`) + ``cache_index`` →
         ``(logits, new_cache)``; prefill writes slots [idx, idx+S), decode
         steps pass S=1. ``kv_mask`` (B, max_len) marks which cache slots a
-        query may attend (ragged-prompt batches exclude padding slots)."""
+        query may attend (ragged-prompt batches exclude padding slots).
+        Paged serving (serve/paging.py) instead passes a pooled cache from
+        :func:`init_paged_kv_cache` + ``page_table``/``page_size``/
+        ``page_write_ok`` and explicit ``positions``; masking is derived
+        from positions in-branch (kv_mask unused)."""
         cfg = self.cfg
         cfg.validate()
         B, S = tokens.shape
@@ -506,6 +571,9 @@ class TransformerLM(nn.Module):
                     layer_cache=cache[f"layers_{i}"],
                     cache_index=cache_index,
                     kv_mask=kv_mask,
+                    page_table=page_table,
+                    page_size=page_size,
+                    page_write_ok=page_write_ok,
                 )
             else:
                 x = block(x, positions, segment_ids)
@@ -525,6 +593,23 @@ def init_kv_cache(
     layer — GQA configs pay for kv_heads, not n_heads."""
     dtype = dtype or cfg.dtype
     shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
+    return {
+        f"layers_{i}": {
+            "k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)
+        }
+        for i in range(cfg.n_layers)
+    }
+
+
+def init_paged_kv_cache(
+    cfg: TransformerConfig, pool_tokens: int, dtype: Any | None = None
+) -> dict:
+    """Zeroed PAGED decode cache: one flat (kv_heads, pool_tokens,
+    head_dim) K and V per layer, shared by every row through a block table
+    (serve/paging.py). HBM is billed per resident TOKEN, not per
+    (row × max_seq) rectangle."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.kv_heads, pool_tokens, cfg.head_dim)
     return {
         f"layers_{i}": {
             "k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)

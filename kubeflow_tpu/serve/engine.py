@@ -107,11 +107,19 @@ class LMEngine:
         prefill_chunk: int | None = None,
         mesh=None,
         rules=None,
+        kv_pool_tokens: int | None = None,
+        page_size: int = 64,
     ):
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
         self.model, self.cfg = model, cfg
         self.mesh = mesh
+        #: paged KV mode (the vLLM block-table analog, serve/paging.py):
+        #: HBM holds kv_pool_tokens tokens TOTAL instead of a
+        #: (max_batch, max_seq) rectangle — admission is bounded by pages,
+        #: not rows, so mixed-length traffic packs denser.
+        self.paged = kv_pool_tokens is not None
+        self.page_size = page_size
         if mesh is not None:
             # tensor-parallel serving: params laid out by the SAME rules as
             # training (parallel/sharding.py) and the KV cache sharded over
@@ -167,7 +175,31 @@ class LMEngine:
         # device state: the persistent cache. Everything per-row and small
         # (lengths, last tokens, activity) lives host-side as numpy — it
         # rides into each chunk call and costs nothing next to the cache.
-        if self._cache_sharding is not None:
+        if self.paged:
+            from kubeflow_tpu.models.transformer import init_paged_kv_cache
+            from kubeflow_tpu.serve.paging import PageAllocator
+
+            self.pager = PageAllocator(
+                pool_tokens=kv_pool_tokens,
+                page_size=page_size,
+                max_batch=max_batch,
+                max_pages_per_row=-(-max_seq // page_size),
+            )
+            if self._cache_sharding is not None:
+                # pooled layout: heads are axis 0
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                self._cache_sharding = NamedSharding(
+                    self.mesh, P("model", None, None)
+                )
+                self.cache = jax.jit(
+                    lambda: init_paged_kv_cache(cfg, kv_pool_tokens),
+                    out_shardings=self._cache_sharding,
+                )()
+            else:
+                self.cache = init_paged_kv_cache(cfg, kv_pool_tokens)
+        elif self._cache_sharding is not None:
             # allocate DIRECTLY in the sharded layout: materialising the
             # full tree on one device first would OOM exactly the
             # deployments TP serving exists for
@@ -222,12 +254,24 @@ class LMEngine:
         # the result. (A failed donated call kills the buffers; the
         # scheduler's fatal path already fails all requests and the
         # engine is rebuilt on reload.)
-        self._suffix_prefill = jax.jit(
-            self._suffix_prefill_impl, donate_argnums=(0,)
-        )
-        self._implant = jax.jit(self._implant_impl, donate_argnums=(0,))
+        if self.paged:
+            self._suffix_prefill = jax.jit(
+                self._suffix_prefill_paged_impl, donate_argnums=(0,)
+            )
+            self._chunk = jax.jit(
+                self._chunk_paged_impl, donate_argnums=(0,)
+            )
+            self._implant_jits: dict[int, Any] = {}
+            #: a request held back by page backpressure (FIFO preserved:
+            #: nothing admits past it until its pages free up)
+            self._held: "_Request | None" = None
+        else:
+            self._suffix_prefill = jax.jit(
+                self._suffix_prefill_impl, donate_argnums=(0,)
+            )
+            self._implant = jax.jit(self._implant_impl, donate_argnums=(0,))
+            self._chunk = jax.jit(self._chunk_impl, donate_argnums=(0,))
         self._extract_jits: dict[int, Any] = {}
-        self._chunk = jax.jit(self._chunk_impl, donate_argnums=(0,))
 
     # -- device programs ---------------------------------------------------- #
 
@@ -282,27 +326,46 @@ class LMEngine:
         }
 
     def _extract_prefix(self, row: int, n16: int):
-        """Slice row ``row``'s first n16 KV slots (one jit per n16 — the
-        16-multiple quantization bounds this set)."""
+        """Copy row ``row``'s first n16 KV tokens out as a (1, kv_heads,
+        n16, D)-per-layer entry (one jit per n16 — the 16-multiple
+        quantization bounds this set). Dense mode slices the row; paged
+        mode gathers through the block table. SAME output format either
+        way, so the prefix store is cache-layout-agnostic."""
         fn = self._extract_jits.get(n16)
         if fn is None:
             # the cache holds kv_heads (GQA), NOT n_heads
             H, D = self.cfg.kv_heads, self.cfg.head_dim
+            if self.paged:
+                P = self.page_size
 
-            def impl(cache, row):
-                return {
-                    name: {
-                        "k": jax.lax.dynamic_slice(
-                            lc["k"], (row, 0, 0, 0), (1, H, n16, D)
-                        ),
-                        "v": jax.lax.dynamic_slice(
-                            lc["v"], (row, 0, 0, 0), (1, H, n16, D)
-                        ),
+                def impl(cache, table_row):
+                    j = jnp.arange(n16)
+                    idx = table_row[j // P] * P + j % P
+                    return {
+                        name: {
+                            "k": lc["k"][:, idx, :][None],
+                            "v": lc["v"][:, idx, :][None],
+                        }
+                        for name, lc in cache.items()
                     }
-                    for name, lc in cache.items()
-                }
+            else:
+
+                def impl(cache, row):
+                    return {
+                        name: {
+                            "k": jax.lax.dynamic_slice(
+                                lc["k"], (row, 0, 0, 0), (1, H, n16, D)
+                            ),
+                            "v": jax.lax.dynamic_slice(
+                                lc["v"], (row, 0, 0, 0), (1, H, n16, D)
+                            ),
+                        }
+                        for name, lc in cache.items()
+                    }
 
             fn = self._extract_jits[n16] = jax.jit(impl)
+        if self.paged:
+            return fn(self.cache, jnp.asarray(self.pager.table[row]))
         return fn(self.cache, row)
 
     def _chunk_impl(
@@ -352,6 +415,112 @@ class LMEngine:
         )
         return cache, tok, gen_count, active, toks.T, valid.T  # (B, T)
 
+    # -- paged device programs (serve/paging.py block-table mode) ----------- #
+
+    def _pages_w(self, tokens: int) -> int:
+        """Read-window width in pages: pow2-rounded so the compiled
+        program set stays bounded, capped at the per-row maximum."""
+        need = -(-tokens // self.page_size)
+        w = 1
+        while w < need:
+            w *= 2
+        return min(w, self.pager.max_pages_per_row)
+
+    def _suffix_prefill_paged_impl(
+        self, cache, suffix, slen, offset, table, temperature, rng
+    ):
+        """Paged twin of _suffix_prefill_impl: one row's prefill piece
+        writes tokens [offset, offset+S) through its block table. Pad
+        positions (>= slen) route to the scratch page. The read window is
+        ``table`` width × page_size (pow2-bucketed by the caller)."""
+        S = suffix.shape[1]
+        positions = offset + jnp.arange(S)[None, :]          # (1, S)
+        write_ok = (jnp.arange(S) < slen[:, None])           # (1, S)
+        logits, cache = self.model.apply(
+            {"params": self.params}, suffix, cache=cache,
+            positions=positions, page_table=table,
+            page_size=self.page_size, page_write_ok=write_ok,
+        )
+        last = jnp.take_along_axis(
+            logits, (slen - 1)[:, None, None], axis=1
+        )[:, 0]
+        tok = _sample(last, rng, temperature[None])[0]
+        return cache, tok, tok != self.eos_id
+
+    def _implant_paged(self, stored, row: int, n16: int):
+        """Scatter a stored prefix (1, kv_heads, n16, D per layer — the
+        SAME entry format as dense mode, so the prefix store is layout-
+        agnostic) into row ``row``'s pages at token indices [0, n16)."""
+        fn = self._implant_jits.get(n16)
+        if fn is None:
+            P = self.page_size
+
+            def impl(cache, stored, table_row):
+                j = jnp.arange(n16)
+                idx = table_row[j // P] * P + j % P
+                return {
+                    name: {
+                        "k": cache[name]["k"].at[:, idx, :].set(
+                            stored[name]["k"][0].astype(
+                                cache[name]["k"].dtype
+                            )
+                        ),
+                        "v": cache[name]["v"].at[:, idx, :].set(
+                            stored[name]["v"][0].astype(
+                                cache[name]["v"].dtype
+                            )
+                        ),
+                    }
+                    for name in cache
+                }
+
+            fn = self._implant_jits[n16] = jax.jit(
+                impl, donate_argnums=(0,)
+            )
+        self.cache = fn(
+            self.cache, stored, jnp.asarray(self.pager.table[row])
+        )
+
+    def _chunk_paged_impl(
+        self, cache, last_tok, real_len, gen_count, active, budget,
+        temperature, rng, table,
+    ):
+        """Paged twin of _chunk_impl. A row's token space is CONTIGUOUS
+        (gen token g sits at token index real_len + g — no quantized gap),
+        so position == token index and the model's paged branch derives
+        causal/window masking from positions alone. Dead rows still step
+        (SPMD) but their writes route to the scratch page — their pages
+        may already belong to another row."""
+
+        def step(carry, _):
+            cache, tok, gen_count, active, rng = carry
+            rng, sub = jax.random.split(rng)
+            live = active & (gen_count < budget)             # (B,)
+            cur = real_len + gen_count - 1                   # (B,) token idx
+            lg, cache = self.model.apply(
+                {"params": self.params},
+                tok[:, None],
+                cache=cache,
+                positions=cur[:, None],
+                page_table=table,
+                page_size=self.page_size,
+                page_write_ok=live[:, None],
+            )
+            nxt = _sample(lg[:, 0], sub, temperature)
+            valid = live & (nxt != self.eos_id)
+            out = jnp.where(valid, nxt, self.pad_id)
+            gen_count = jnp.where(live, gen_count + 1, gen_count)
+            tok = jnp.where(valid, out, tok)
+            return (cache, tok, gen_count, valid, rng), (out, valid)
+
+        (cache, tok, gen_count, active, _), (toks, valid) = jax.lax.scan(
+            step,
+            (cache, last_tok, gen_count, active, rng),
+            None,
+            length=self.chunk_steps,
+        )
+        return cache, tok, gen_count, active, toks.T, valid.T  # (B, T)
+
     # -- host scheduler ----------------------------------------------------- #
 
     def start(self) -> "LMEngine":
@@ -375,6 +544,10 @@ class LMEngine:
                 self._slots[row] = None
                 req.error = err
                 req.finish()
+        if self.paged and self._held is not None:
+            self._held.error = err
+            self._held.finish()
+            self._held = None
         while True:
             try:
                 req = self._pending.get_nowait()
@@ -398,23 +571,50 @@ class LMEngine:
         # beyond max_batch + max_queue is shed — an unbounded tail would
         # wait longer than any client timeout
         occupied = sum(s is not None for s in self._slots)
-        if self._pending.qsize() + occupied >= self.max_batch + self.max_queue:
+        held = 1 if self.paged and self._held is not None else 0
+        if (
+            self._pending.qsize() + occupied + held
+            >= self.max_batch + self.max_queue
+        ):
             raise EngineOverloaded(
                 f"engine at capacity ({occupied} decoding, "
-                f"{self._pending.qsize()} queued, max_queue={self.max_queue})"
+                f"{self._pending.qsize() + held} queued, "
+                f"max_queue={self.max_queue})"
             )
-        if self.prefill_chunk is not None:
+        if self.paged:
+            # token space is contiguous in paged mode (no bucket-padding
+            # gap), so the real bound is prompt + generation tokens — both
+            # against max_seq (per-row page table width) and the pool
+            if len(ids) + max_new_tokens > self.max_seq:
+                raise ValueError(
+                    f"prompt {len(ids)} + max_new_tokens {max_new_tokens} "
+                    f"exceeds engine max_seq {self.max_seq}"
+                )
+            need = self.pager.pages_for(len(ids) + max_new_tokens)
+            if need > self.pager.num_pages - 1:
+                raise ValueError(
+                    f"request needs {need} pages; pool has "
+                    f"{self.pager.num_pages - 1} — raise kv_pool_tokens"
+                )
+            if self.prefill_chunk is None:
+                self._bucket(len(ids))  # reject over-bucket prompts now
+        elif self.prefill_chunk is not None:
             # chunked prefill frees prompts from the bucket bound: the only
             # limit is the piece layout fitting max_seq
             C = self.prefill_chunk
             layout = -(-len(ids) // C) * C
+            if layout + max_new_tokens > self.max_seq:
+                raise ValueError(
+                    f"prompt layout {layout} + max_new_tokens "
+                    f"{max_new_tokens} exceeds engine max_seq {self.max_seq}"
+                )
         else:
             layout = self._bucket(len(ids))
-        if layout + max_new_tokens > self.max_seq:
-            raise ValueError(
-                f"prompt layout {layout} + max_new_tokens {max_new_tokens} "
-                f"exceeds engine max_seq {self.max_seq}"
-            )
+            if layout + max_new_tokens > self.max_seq:
+                raise ValueError(
+                    f"prompt layout {layout} + max_new_tokens "
+                    f"{max_new_tokens} exceeds engine max_seq {self.max_seq}"
+                )
         req = _Request(
             list(ids), max_new_tokens, temperature,
             live=queue.Queue() if live else None,
@@ -496,13 +696,25 @@ class LMEngine:
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
                 return
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                return
+            if self.paged and self._held is not None:
+                req, self._held = self._held, None
+            else:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    return
             if req.cancelled.is_set():
                 req.finish()  # consumer already gone: never admit
                 continue
+            if self.paged:
+                need = self.pager.pages_for(
+                    len(req.ids) + req.max_new_tokens
+                )
+                if not self.pager.can_alloc(need):
+                    # page backpressure: hold THIS request (FIFO — nothing
+                    # admits past it) until completions free pages
+                    self._held = req
+                    return
             row = free[0]
             try:
                 self._admit(req, row)
@@ -582,11 +794,26 @@ class LMEngine:
             # would waste cache slots and blow the max_seq layout check
             C = self.prefill_chunk or ((len(suffix_ids) + 15) // 16) * 16
             n_pieces = -(-len(suffix_ids) // C)
-            if n16 + n_pieces * C + req.max_new_tokens <= self.max_seq:
+            # paged rows have no quantized layout: contiguous tokens
+            # (len + max_new <= max_seq, enforced at enqueue) always fit —
+            # piece padding routes to the scratch page. Dense rows must
+            # fit the padded layout.
+            if self.paged or (
+                n16 + n_pieces * C + req.max_new_tokens <= self.max_seq
+            ):
                 implanted = (n16, stored, suffix_ids, C, n_pieces)
+        if self.paged:
+            # claim pages FIRST: _admit_all verified availability; implant
+            # needs the table row populated
+            self.pager.alloc(
+                row, self.pager.pages_for(len(req.ids) + req.max_new_tokens)
+            )
         if implanted is not None:
             n16, stored, rest, C, n_pieces = implanted
-            self.cache = self._implant(self.cache, stored, row)
+            if self.paged:
+                self._implant_paged(stored, row, n16)
+            else:
+                self.cache = self._implant(self.cache, stored, row)
             base = n16
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_reused"] += n16
@@ -595,7 +822,9 @@ class LMEngine:
             # formula) — no recheck needed here
             C = self.prefill_chunk or self._bucket(len(rest))
             n_pieces = -(-len(rest) // C)
-        gen_start = base + n_pieces * C
+        # paged rows have NO quantized gap: generation continues at the
+        # next token index, so position == token index throughout
+        gen_start = len(req.ids) if self.paged else base + n_pieces * C
         req.row, req.gen_start = row, gen_start
         self._slots[row] = req
         self.real_len[row] = len(req.ids)
@@ -607,6 +836,10 @@ class LMEngine:
         self.stats["max_concurrent"] = max(
             self.stats["max_concurrent"], sum(s is not None for s in self._slots)
         )
+        if self.paged:
+            self.stats["pages_used_peak"] = max(
+                self.stats.get("pages_used_peak", 0), self.pager.used_pages
+            )
         self._prefilling[row] = {
             "req": req, "rest": rest, "base": base, "C": C,
             "n_pieces": n_pieces, "piece": 0,
@@ -628,15 +861,27 @@ class LMEngine:
         piece = np.full((1, C), self.pad_id, np.int32)
         piece[0, : len(piece_ids)] = piece_ids
         self._rng, sub = jax.random.split(self._rng)
-        self.cache, tok, valid = self._suffix_prefill(
-            self.cache,
-            jnp.asarray(piece),
-            jnp.asarray([len(piece_ids)], np.int32),
-            base + i * C,
-            row,
-            jnp.float32(req.temperature),
-            sub,
-        )
+        if self.paged:
+            pages_w = self._pages_w(base + i * C + C)
+            self.cache, tok, valid = self._suffix_prefill(
+                self.cache,
+                jnp.asarray(piece),
+                jnp.asarray([len(piece_ids)], np.int32),
+                base + i * C,
+                jnp.asarray(self.pager.table[row : row + 1, :pages_w]),
+                jnp.float32(req.temperature),
+                sub,
+            )
+        else:
+            self.cache, tok, valid = self._suffix_prefill(
+                self.cache,
+                jnp.asarray(piece),
+                jnp.asarray([len(piece_ids)], np.int32),
+                base + i * C,
+                row,
+                jnp.float32(req.temperature),
+                sub,
+            )
         self.stats["prefill_pieces"] += 1
         st["piece"] = i + 1
         if not final:
@@ -669,6 +914,8 @@ class LMEngine:
         self._slots[row] = None
         self.active[row] = False
         self._prefilling.pop(row, None)
+        if self.paged:
+            self.pager.free(row)
         if req is not None:
             # count BEFORE done.set(): callers may read/reset stats the
             # moment their submit returns (warmup does)
@@ -689,6 +936,10 @@ class LMEngine:
                     req.error = e
                     self._slots[row] = None
                     req.finish()
+            if self.paged and self._held is not None:
+                self._held.error = e
+                self._held.finish()
+                self._held = None
             while True:
                 try:
                     req = self._pending.get_nowait()
@@ -709,19 +960,42 @@ class LMEngine:
                 self._work.clear()
                 continue
             self._rng, sub = jax.random.split(self._rng)
-            (
-                self.cache, tok, gen_count, active, toks, valid
-            ) = self._chunk(
-                self.cache,
-                jnp.asarray(self.last_tok),
-                jnp.asarray(self.real_len),
-                jnp.asarray(self.gen_start),
-                jnp.asarray(self.gen_count),
-                jnp.asarray(self.active),
-                jnp.asarray(self.budget),
-                jnp.asarray(self.temp),
-                sub,
-            )
+            if self.paged:
+                # read window: the furthest token any ACTIVE row can reach
+                # this chunk, pow2-page-bucketed → bounded program set
+                horizon = int(
+                    (
+                        (self.real_len + self.gen_count)[self.active]
+                    ).max()
+                ) + self.chunk_steps
+                pages_w = self._pages_w(horizon)
+                (
+                    self.cache, tok, gen_count, active, toks, valid
+                ) = self._chunk(
+                    self.cache,
+                    jnp.asarray(self.last_tok),
+                    jnp.asarray(self.real_len),
+                    jnp.asarray(self.gen_count),
+                    jnp.asarray(self.active),
+                    jnp.asarray(self.budget),
+                    jnp.asarray(self.temp),
+                    sub,
+                    jnp.asarray(self.pager.table[:, :pages_w]),
+                )
+            else:
+                (
+                    self.cache, tok, gen_count, active, toks, valid
+                ) = self._chunk(
+                    self.cache,
+                    jnp.asarray(self.last_tok),
+                    jnp.asarray(self.real_len),
+                    jnp.asarray(self.gen_start),
+                    jnp.asarray(self.gen_count),
+                    jnp.asarray(self.active),
+                    jnp.asarray(self.budget),
+                    jnp.asarray(self.temp),
+                    sub,
+                )
             self.stats["chunks"] += 1
             toks = np.asarray(toks)
             valid = np.asarray(valid)
@@ -797,7 +1071,8 @@ class LMEngineModel(LMRuntimeModel):
     def __init__(
         self, name, storage_path=None, *, max_batch=8, max_seq=None,
         chunk_steps=8, prefix_cache_entries=0, prefix_cache_tokens=None,
-        prefill_chunk=None, mesh=None, rules=None, **kwargs,
+        prefill_chunk=None, mesh=None, rules=None,
+        kv_pool_tokens=None, page_size=64, **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
@@ -807,6 +1082,8 @@ class LMEngineModel(LMRuntimeModel):
         self._engine_mesh = mesh
         self._engine_rules = rules
         self._engine_prefill_chunk = prefill_chunk
+        self._engine_pool_tokens = kv_pool_tokens
+        self._engine_page_size = page_size
         self._engine_max_seq = max_seq or (
             self.buckets.seq_lens[-1] + self.max_new_tokens
         )
@@ -843,6 +1120,8 @@ class LMEngineModel(LMRuntimeModel):
             prefill_chunk=self._engine_prefill_chunk,
             mesh=self._engine_mesh,
             rules=self._engine_rules,
+            kv_pool_tokens=self._engine_pool_tokens,
+            page_size=self._engine_page_size,
         ).start()
         return True
 

@@ -1,0 +1,350 @@
+"""Paged KV cache (serve/paging.py + engine paged mode): the vLLM
+block-table analog. The invariant everywhere: PAGING IS A LAYOUT, NOT A
+NUMERICS CHANGE — every completion must equal the dense engine's (which
+is itself pinned to the whole-batch generate path), while HBM is billed
+per resident token instead of per (row × max_seq) rectangle."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from kubeflow_tpu.serve.engine import LMEngine
+from kubeflow_tpu.serve.paging import PageAllocator
+
+CFG = TransformerConfig(
+    vocab_size=89, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+    causal=True, max_seq_len=256, attn_impl="reference", dtype=jnp.float32,
+)
+EOS = 1
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return model, params
+
+
+def _prompts(rng, n, lo=3, hi=25, vocab=89):
+    return [
+        [int(x) for x in rng.integers(2, vocab, size=rng.integers(lo, hi))]
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------- allocator
+
+
+def test_allocator_accounting():
+    a = PageAllocator(
+        pool_tokens=16 * 8, page_size=16, max_batch=4, max_pages_per_row=4
+    )
+    assert a.pages_for(1) == 1 and a.pages_for(16) == 1 and a.pages_for(17) == 2
+    assert a.free_pages == 7  # page 0 is scratch
+    a.alloc(0, 3)
+    a.alloc(1, 4)
+    assert a.used_pages == 7 and not a.can_alloc(1)
+    # tables point at distinct non-scratch pages; unused entries at scratch
+    assert len(set(a.table[0, :3]) | set(a.table[1])) == 7
+    assert 0 not in a.table[0, :3] and a.table[0, 3] == 0
+    with pytest.raises(RuntimeError, match="exhausted"):
+        a.alloc(2, 1)
+    with pytest.raises(RuntimeError, match="already holds"):
+        a.alloc(0, 1)
+    a.free(0)
+    assert a.free_pages == 3 and np.all(a.table[0] == 0)
+    a.free(0)  # idempotent
+    with pytest.raises(ValueError, match="max_pages_per_row"):
+        a.alloc(2, 5)
+    with pytest.raises(ValueError, match="16-multiple"):
+        PageAllocator(pool_tokens=64, page_size=10, max_batch=1,
+                      max_pages_per_row=1)
+
+
+# ------------------------------------------------------------------ parity
+
+
+def _dense_and_paged(model, params, *, prefix=0, chunked=None, cfg=CFG,
+                     pool_tokens=16 * 20, max_batch=4):
+    dense = LMEngine(
+        model, cfg, params, max_batch=max_batch, max_seq=64, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS, prefix_cache_entries=prefix,
+        prefill_chunk=chunked,
+    ).start()
+    paged = LMEngine(
+        model, cfg, params, max_batch=max_batch, max_seq=64, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS, prefix_cache_entries=prefix,
+        prefill_chunk=chunked, kv_pool_tokens=pool_tokens, page_size=16,
+    ).start()
+    return dense, paged
+
+
+def test_paged_matches_dense_exactly(model_and_params):
+    model, params = model_and_params
+    dense, paged = _dense_and_paged(model, params)
+    try:
+        rng = np.random.default_rng(0)
+        for ids in _prompts(rng, 8):
+            want = dense.submit(ids, max_new_tokens=12)
+            got = paged.submit(ids, max_new_tokens=12)
+            assert got == want, (ids, got, want)
+        assert paged.pager.used_pages == 0  # everything freed
+    finally:
+        dense.stop()
+        paged.stop()
+
+
+def test_paged_concurrent_staggered(model_and_params):
+    """Continuous batching on the paged cache: staggered arrivals share
+    the running batch and still match the dense engine."""
+    model, params = model_and_params
+    dense, paged = _dense_and_paged(model, params, max_batch=3)
+    rng = np.random.default_rng(1)
+    prompts = _prompts(rng, 7)
+    want = {}
+    results: dict[int, list[int]] = {}
+    errors: list[Exception] = []
+
+    def worker(i):
+        try:
+            time.sleep(0.03 * i)
+            results[i] = paged.submit(prompts[i], max_new_tokens=16)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(7)]
+    try:
+        for i, ids in enumerate(prompts):
+            want[i] = dense.submit(ids, max_new_tokens=16)
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+    finally:
+        dense.stop()
+        paged.stop()
+    assert not errors, errors
+    assert results == want
+    assert paged.stats["max_concurrent"] >= 2
+
+
+def test_paged_prefix_cache_parity_and_reuse(model_and_params):
+    """Automatic prefix caching on the paged cache: exact same tokens,
+    real reuse, and the stored-entry format interchangeable with dense
+    mode (extract gathers through the table, implant scatters)."""
+    model, params = model_and_params
+    dense, paged = _dense_and_paged(model, params, prefix=4)
+    try:
+        shared = [7] * 20
+        tails = [[11, 12], [13, 14, 15], [16]]
+        for tail in tails:
+            want = dense.submit(shared + tail, max_new_tokens=10)
+            got = paged.submit(shared + tail, max_new_tokens=10)
+            assert got == want, (tail, got, want)
+        assert paged.stats["prefix_hits"] >= 2
+        assert paged.stats["prefix_tokens_reused"] >= 32
+    finally:
+        dense.stop()
+        paged.stop()
+
+
+def test_paged_chunked_prefill_parity(model_and_params):
+    model, params = model_and_params
+    dense, paged = _dense_and_paged(model, params, chunked=16,
+                                    pool_tokens=16 * 24)
+    try:
+        rng = np.random.default_rng(3)
+        for ids in _prompts(rng, 4, lo=20, hi=45):
+            want = dense.submit(ids, max_new_tokens=8)
+            got = paged.submit(ids, max_new_tokens=8)
+            assert got == want, (len(ids), got, want)
+        assert paged.stats["prefill_pieces"] > 4  # really chunked
+    finally:
+        dense.stop()
+        paged.stop()
+
+
+def test_paged_sliding_window_and_gqa(model_and_params):
+    """Window + GQA ride the paged branch's position-space mask."""
+    import dataclasses
+
+    for variant in (
+        dataclasses.replace(CFG, attn_window=4),
+        dataclasses.replace(CFG, n_kv_heads=2),
+    ):
+        model = TransformerLM(variant)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        dense, paged = _dense_and_paged(model, params, cfg=variant)
+        try:
+            rng = np.random.default_rng(5)
+            for ids in _prompts(rng, 4, lo=6, hi=20):
+                want = dense.submit(ids, max_new_tokens=10)
+                got = paged.submit(ids, max_new_tokens=10)
+                assert got == want, (variant.attn_window, got, want)
+        finally:
+            dense.stop()
+            paged.stop()
+
+
+# ------------------------------------------------------- density/backpressure
+
+
+def test_page_backpressure_queues_and_completes(model_and_params):
+    """A pool too small for all concurrent requests must QUEUE the
+    overflow (FIFO, no failure) and finish everything as pages free."""
+    model, params = model_and_params
+    # 8 pages of 16 = 128 tokens; each request needs (20 + 12)/16 -> 2
+    # pages, so only 3-4 of the 8 requests fit at once
+    eng = LMEngine(
+        model, CFG, params, max_batch=8, max_seq=64, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS,
+        kv_pool_tokens=16 * 9, page_size=16,
+    ).start()
+    ref = LMEngine(
+        model, CFG, params, max_batch=8, max_seq=64, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    rng = np.random.default_rng(7)
+    prompts = _prompts(rng, 8, lo=17, hi=21)
+    results: dict[int, list[int]] = {}
+    errors: list[Exception] = []
+
+    def worker(i):
+        try:
+            results[i] = eng.submit(prompts[i], max_new_tokens=12,
+                                    timeout_s=120)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(150)
+        assert not errors, errors
+        assert len(results) == 8
+        for i, ids in enumerate(prompts):
+            assert results[i] == ref.submit(ids, max_new_tokens=12), i
+        # the pool bound really bit: peak pages within budget, and fewer
+        # rows ran concurrently than max_batch allows
+        assert eng.stats["pages_used_peak"] <= 8
+        assert eng.stats["max_concurrent"] <= 4
+    finally:
+        eng.stop()
+        ref.stop()
+
+
+def test_paged_density_vs_dense_rectangle(model_and_params):
+    """The point of paging: mixed-length rows resident in a pool ~3.6x
+    smaller than the dense rectangle. 8 concurrent rows of <=48 tokens
+    each fit in 576 pool tokens (9 pages: 8 allocatable + scratch) where
+    dense billing would need 8 x 256 = 2048 — >=2x density in the same
+    HBM budget."""
+    model, params = model_and_params
+    max_seq = 256
+    pool_tokens = 64 * 9
+    dense_rectangle = 8 * max_seq
+    assert dense_rectangle / pool_tokens >= 2  # the VERDICT bar, by design
+    eng = LMEngine(
+        model, CFG, params, max_batch=8, max_seq=max_seq, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS,
+        kv_pool_tokens=pool_tokens, page_size=64,
+    ).start()
+    rng = np.random.default_rng(11)
+    prompts = _prompts(rng, 8, lo=10, hi=30)
+    results: dict[int, list[int]] = {}
+    errors: list[Exception] = []
+
+    def worker(i):
+        try:
+            results[i] = eng.submit(prompts[i], max_new_tokens=16,
+                                    timeout_s=120)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(150)
+        assert not errors, errors
+        # ALL 8 mixed-length rows were resident simultaneously in a pool
+        # 4x smaller than their dense rectangle
+        assert eng.stats["max_concurrent"] == 8
+        assert eng.stats["pages_used_peak"] * 64 <= pool_tokens
+    finally:
+        eng.stop()
+
+
+def test_request_larger_than_pool_fails_fast(model_and_params):
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=2, max_seq=128, chunk_steps=2,
+        prefill_buckets=(32, 128), eos_id=EOS,
+        kv_pool_tokens=16 * 4, page_size=16,
+    ).start()
+    try:
+        with pytest.raises(ValueError, match="raise kv_pool_tokens"):
+            eng.submit(list(range(2, 60)), max_new_tokens=32)
+        # a fitting request still completes after the rejection (this tiny
+        # model may emit EOS immediately — liveness is what's asserted)
+        eng.submit([5, 6, 7], max_new_tokens=4)
+        assert eng.stats["completed"] == 1 and eng._fatal is None
+    finally:
+        eng.stop()
+
+
+def test_tp_paged_engine_matches_unsharded():
+    """TP serving + paged cache compose: pooled KV sharded over kv heads
+    on the model axis, same tokens as the unsharded dense engine."""
+    from jax.sharding import Mesh
+
+    from kubeflow_tpu.parallel.sharding import transformer_rules
+
+    cfg = TransformerConfig(
+        vocab_size=96, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        causal=True, max_seq_len=256, attn_impl="reference",
+        dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("data", "model"))
+
+    plain = LMEngine(
+        model, cfg, params, max_batch=2, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    sharded = LMEngine(
+        model, cfg, params, max_batch=2, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+        mesh=mesh, rules=transformer_rules(fsdp=False),
+        kv_pool_tokens=16 * 16, page_size=16,
+    ).start()
+    try:
+        k0 = next(iter(sharded.cache.values()))["k"]
+        assert "model" in str(k0.sharding.spec)
+        rng = np.random.default_rng(31)
+        for _ in range(3):
+            ids = [int(x) for x in rng.integers(2, 96, size=rng.integers(4, 20))]
+            a = plain.submit(ids, max_new_tokens=10)
+            b = sharded.submit(ids, max_new_tokens=10)
+            assert a == b, (ids, a, b)
+    finally:
+        plain.stop()
+        sharded.stop()
