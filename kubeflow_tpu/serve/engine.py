@@ -112,6 +112,9 @@ class LMEngine:
     ):
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
+        from kubeflow_tpu.core.compcache import enable_compilation_cache
+
+        enable_compilation_cache()  # engine start is compile-dominated
         self.model, self.cfg = model, cfg
         self.mesh = mesh
         #: paged KV mode (the vLLM block-table analog, serve/paging.py):
@@ -583,13 +586,9 @@ class LMEngine:
             )
         if self.paged:
             # token space is contiguous in paged mode (no bucket-padding
-            # gap), so the real bound is prompt + generation tokens — both
-            # against max_seq (per-row page table width) and the pool
-            if len(ids) + max_new_tokens > self.max_seq:
-                raise ValueError(
-                    f"prompt {len(ids)} + max_new_tokens {max_new_tokens} "
-                    f"exceeds engine max_seq {self.max_seq}"
-                )
+            # gap), so the layout IS the prompt itself — bounded against
+            # max_seq (per-row page table width) and the pool
+            layout = len(ids)
             need = self.pager.pages_for(len(ids) + max_new_tokens)
             if need > self.pager.num_pages - 1:
                 raise ValueError(
@@ -603,18 +602,13 @@ class LMEngine:
             # limit is the piece layout fitting max_seq
             C = self.prefill_chunk
             layout = -(-len(ids) // C) * C
-            if layout + max_new_tokens > self.max_seq:
-                raise ValueError(
-                    f"prompt layout {layout} + max_new_tokens "
-                    f"{max_new_tokens} exceeds engine max_seq {self.max_seq}"
-                )
         else:
             layout = self._bucket(len(ids))
-            if layout + max_new_tokens > self.max_seq:
-                raise ValueError(
-                    f"prompt layout {layout} + max_new_tokens "
-                    f"{max_new_tokens} exceeds engine max_seq {self.max_seq}"
-                )
+        if layout + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt layout {layout} + max_new_tokens {max_new_tokens} "
+                f"exceeds engine max_seq {self.max_seq}"
+            )
         req = _Request(
             list(ids), max_new_tokens, temperature,
             live=queue.Queue() if live else None,

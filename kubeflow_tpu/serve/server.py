@@ -147,6 +147,11 @@ class ModelServer:
     ):
         self.http_port = http_port
         self.grpc_port = grpc_port
+        # cold start is compile-dominated (BASELINE config 5): persist XLA
+        # compiles so every server start after the first skips them
+        from kubeflow_tpu.core.compcache import enable_compilation_cache
+
+        enable_compilation_cache()
         self.dataplane = DataPlane(logger=logger)
         self._batcher_cfg = batcher
         self._graphs: dict[str, Any] = {}  # name → InferenceGraph

@@ -88,6 +88,9 @@ class Trainer:
         config: TrainConfig,
         param_spec_fn: Callable[[Any], Any] | None = None,
     ):
+        from kubeflow_tpu.core.compcache import enable_compilation_cache
+
+        enable_compilation_cache()  # restarts skip the train-step compile
         self.config = config
         self.loss_fn = loss_fn
         self.optimizer = optimizer
