@@ -1018,15 +1018,18 @@ def _probe_backend(timeout_s: float = 120.0) -> str:
 
 
 def main() -> int:
-    from kubeflow_tpu.core.compcache import enable_compilation_cache
-
-    enable_compilation_cache()  # cold_start_s measures the cached path on
-    # any run after the first — exactly what a restarted server pays
     device_benches = (
         bench_mnist, bench_resnet, bench_bert, bench_serving, bench_generate,
         bench_engine,
     )
     backend = _probe_backend()
+    # AFTER the probe (probe-first contract: no in-process jax before the
+    # subprocess liveness check): persist XLA compiles so cold_start_s
+    # measures the cached path on any run after the first — exactly what
+    # a restarted server pays
+    from kubeflow_tpu.core.compcache import enable_compilation_cache
+
+    enable_compilation_cache()
     alive = backend != "unreachable"
     results: list[dict] = []
     for fn in (

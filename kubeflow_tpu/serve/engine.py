@@ -586,9 +586,24 @@ class LMEngine:
             )
         if self.paged:
             # token space is contiguous in paged mode (no bucket-padding
-            # gap), so the layout IS the prompt itself — bounded against
-            # max_seq (per-row page table width) and the pool
+            # gap), so the layout IS the prompt itself
             layout = len(ids)
+        elif self.prefill_chunk is not None:
+            # chunked prefill frees prompts from the bucket bound: the only
+            # limit is the piece layout fitting max_seq
+            C = self.prefill_chunk
+            layout = -(-len(ids) // C) * C
+        else:
+            layout = self._bucket(len(ids))
+        # max_seq FIRST: a request over the per-row bound must say so —
+        # "raise kv_pool_tokens" would be a lie when no pool size can fit
+        # it in the page-table width
+        if layout + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt layout {layout} + max_new_tokens {max_new_tokens} "
+                f"exceeds engine max_seq {self.max_seq}"
+            )
+        if self.paged:
             need = self.pager.pages_for(len(ids) + max_new_tokens)
             if need > self.pager.num_pages - 1:
                 raise ValueError(
@@ -597,18 +612,6 @@ class LMEngine:
                 )
             if self.prefill_chunk is None:
                 self._bucket(len(ids))  # reject over-bucket prompts now
-        elif self.prefill_chunk is not None:
-            # chunked prefill frees prompts from the bucket bound: the only
-            # limit is the piece layout fitting max_seq
-            C = self.prefill_chunk
-            layout = -(-len(ids) // C) * C
-        else:
-            layout = self._bucket(len(ids))
-        if layout + max_new_tokens > self.max_seq:
-            raise ValueError(
-                f"prompt layout {layout} + max_new_tokens {max_new_tokens} "
-                f"exceeds engine max_seq {self.max_seq}"
-            )
         req = _Request(
             list(ids), max_new_tokens, temperature,
             live=queue.Queue() if live else None,

@@ -1188,6 +1188,10 @@ def test_compilation_cache_speeds_second_cold_start(tmp_path):
     env = dict(
         os.environ, KFT_COMPILATION_CACHE_DIR=cache_dir, JAX_PLATFORMS="cpu"
     )
+    # ambient settings on a developer machine must not defeat the test's
+    # own cache dir (compcache keeps a pre-set JAX dir verbatim)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.pop("KFT_NO_COMPILATION_CACHE", None)
 
     def run():
         r = subprocess.run(
