@@ -152,57 +152,67 @@ class ElasticAutoscaler:
             if status is None or job is None or status.finished:
                 self.unregister(uid)
                 continue
-            pol = st.policy
-            replicas = job.spec.replicas[pol.group].replicas
-            measured = self.metric_fn(uid, pol)
-            st.last_measured = measured
-            if measured is None:
-                continue  # no signal yet (booting, no metrics logged)
-            desired = pol.desired(replicas, measured)
-            if desired == replicas:
-                st.down_pending = None
-                continue
-            if now - st.last_resize < pol.cooldown_s:
-                continue
-            if desired > replicas:
-                st.down_pending = None  # up wins immediately (HPA)
-            else:
-                # stabilize: a shrink must HOLD for the window, and what
-                # gets applied is the MOST CONSERVATIVE (largest)
-                # recommendation seen during it — K8s HPA's scale-down
-                # stabilization: a brief dip must never shrink deeper
-                # than the standing load justifies
-                if st.down_pending is None:
-                    st.down_pending = (desired, now)
-                    continue
-                held, since = st.down_pending
-                held = max(held, desired)
-                st.down_pending = (held, since)
-                if now - since < pol.scale_down_stabilization_s:
-                    continue
-                desired = held
-                st.down_pending = None
-                if desired >= replicas:
-                    continue
-            got = self.cluster.scale(uid, desired)
-            st.last_resize = now
-            self.events.append(
-                {
-                    "uid": uid, "from": replicas, "to": got,
-                    "measured": measured, "target": pol.target,
-                    "at": now,
-                }
-            )
-            logger.info(
-                "autoscale %s: %d -> %d (%s=%.4g target=%.4g)",
-                uid, replicas, got, pol.metric, measured, pol.target,
-            )
-            applied[uid] = got
+            try:
+                self._evaluate(uid, st, job, now, applied)
+            except Exception:  # noqa: BLE001 — one job's bad policy or a
+                # failed scale() must not starve the jobs after it
+                logger.exception("autoscale evaluation failed for %s", uid)
         return applied
+
+    def _evaluate(self, uid, st, job, now, applied) -> None:
+        pol = st.policy
+        replicas = job.spec.replicas[pol.group].replicas
+        measured = self.metric_fn(uid, pol)
+        st.last_measured = measured
+        if measured is None:
+            return  # no signal yet (booting, no metrics logged)
+        desired = pol.desired(replicas, measured)
+        if desired == replicas:
+            st.down_pending = None
+            return
+        if now - st.last_resize < pol.cooldown_s:
+            return
+        if desired > replicas:
+            st.down_pending = None  # up wins immediately (HPA)
+        else:
+            # stabilize: a shrink must HOLD for the window, and what
+            # gets applied is the MOST CONSERVATIVE (largest)
+            # recommendation seen during it — K8s HPA's scale-down
+            # stabilization: a brief dip must never shrink deeper
+            # than the standing load justifies
+            if st.down_pending is None:
+                st.down_pending = (desired, now)
+                return
+            held, since = st.down_pending
+            held = max(held, desired)
+            st.down_pending = (held, since)
+            if now - since < pol.scale_down_stabilization_s:
+                return
+            desired = held
+            st.down_pending = None
+            if desired >= replicas:
+                return
+        got = self.cluster.scale(uid, desired)
+        st.last_resize = now
+        self.events.append(
+            {
+                "uid": uid, "from": replicas, "to": got,
+                "measured": measured, "target": pol.target,
+                "at": now,
+            }
+        )
+        logger.info(
+            "autoscale %s: %d -> %d (%s=%.4g target=%.4g)",
+            uid, replicas, got, pol.metric, measured, pol.target,
+        )
+        applied[uid] = got
 
     # ------------------------------------------------------------------ #
 
     def start(self) -> "ElasticAutoscaler":
+        if self._thread is not None:
+            return self  # already running: don't leak a second loop
+        self._stop.clear()  # a stop()/start() cycle must actually restart
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="kft-autoscaler"
         )

@@ -105,9 +105,12 @@ class NASSpace:
 class SuperNet(nn.Module):
     """One-shot model: stem → mixed-op cell → head. Architecture weights
     ``alpha`` [n_edges, n_ops] come in as an argument so the same apply
-    serves both bilevel updates."""
+    serves both bilevel updates. ``weights_are_probs`` makes alpha rows
+    direct mixing weights (ENAS passes hard one-hot/zero rows — weight
+    sharing: one parameter set, many sampled paths) instead of logits."""
 
     space: NASSpace
+    weights_are_probs: bool = False
 
     @nn.compact
     def __call__(self, x, alpha):
@@ -120,7 +123,10 @@ class SuperNet(nn.Module):
             for e, (i, jj) in enumerate(sp.edges):
                 if jj != j:
                     continue
-                w = jax.nn.softmax(alpha[e])
+                w = (
+                    alpha[e] if self.weights_are_probs
+                    else jax.nn.softmax(alpha[e])
+                )
                 mixed = 0.0
                 for k, op_name in enumerate(sp.ops):
                     op = OPS[op_name](sp.channels)
@@ -244,3 +250,230 @@ class DARTSSearcher:
         p = jax.nn.softmax(self.alpha, axis=-1)
         ent = -(p * jnp.log(p + 1e-9)).sum(-1)
         return float(ent.mean())
+
+
+# --------------------------------------------------------------------------- #
+# ENAS: RL-controller NAS with weight sharing
+# --------------------------------------------------------------------------- #
+
+
+class ControllerNet(nn.Module):
+    """ENAS's autoregressive LSTM controller over the micro cell space
+    (Katib pkg/suggestion/v1beta1/nas/enas upstream analog — UNVERIFIED,
+    SURVEY.md §0). For each intermediate node it emits two (input-node,
+    op) decisions, each conditioned on everything sampled so far through
+    the LSTM state; invalid input nodes (>= current node) are masked. The
+    decision count is static, so the whole rollout — sampling included —
+    is one jitted program.
+
+    ``__call__(rng, greedy)`` → (inputs [nodes,2], ops [nodes,2],
+    sum-log-prob of the taken decisions, total policy entropy)."""
+
+    space: NASSpace
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, rng, greedy: bool = False):
+        sp = self.space
+        n_in = sp.nodes + 1  # candidate input nodes (0 = cell input)
+        cell = nn.OptimizedLSTMCell(features=self.hidden)
+        carry = cell.initialize_carry(jax.random.PRNGKey(0), (1, self.hidden))
+        inp_embed = self.param(
+            "inp_embed", nn.initializers.normal(0.1), (n_in, self.hidden)
+        )
+        op_embed = self.param(
+            "op_embed", nn.initializers.normal(0.1),
+            (len(sp.ops), self.hidden),
+        )
+        start = self.param(
+            "start", nn.initializers.normal(0.1), (self.hidden,)
+        )
+        head_in = nn.Dense(n_in, name="head_input")
+        head_op = nn.Dense(len(sp.ops), name="head_op")
+
+        def pick(rng, logits):
+            p = jax.nn.log_softmax(logits)
+            choice = jnp.where(
+                greedy, jnp.argmax(logits), jax.random.categorical(rng, logits)
+            )
+            ent = -(jnp.exp(p) * p).sum()
+            return choice, p[choice], ent
+
+        x = start[None]
+        inputs, ops = [], []
+        logp = 0.0
+        entropy = 0.0
+        for j in range(1, sp.nodes + 1):
+            row_in, row_op = [], []
+            for _slot in range(2):
+                carry, h = cell(carry, x)
+                mask = jnp.where(jnp.arange(n_in) < j, 0.0, -1e9)
+                rng, k = jax.random.split(rng)
+                i, lp, ent = pick(k, head_in(h)[0] + mask)
+                logp, entropy = logp + lp, entropy + ent
+                x = inp_embed[i][None]
+                carry, h = cell(carry, x)
+                rng, k = jax.random.split(rng)
+                o, lp, ent = pick(k, head_op(h)[0])
+                logp, entropy = logp + lp, entropy + ent
+                x = op_embed[o][None]
+                row_in.append(i)
+                row_op.append(o)
+            inputs.append(jnp.stack(row_in))
+            ops.append(jnp.stack(row_op))
+        return jnp.stack(inputs), jnp.stack(ops), logp, entropy
+
+
+class ENASSearcher:
+    """ENAS (Pham et al.): weight sharing + REINFORCE.
+
+    Alternates two jitted phases per :meth:`step`: (1) train the SHARED
+    supernet weights on the train split through one controller-sampled
+    path (hard one-hot edge weights — the TPU-idiom form of ENAS's
+    subgraph activation: dense masked compute instead of a dynamic
+    graph); (2) update the controller by REINFORCE on the sampled path's
+    validation accuracy against a moving-average baseline, with an
+    entropy bonus. ``derive()`` is the greedy controller rollout.
+    """
+
+    def __init__(
+        self,
+        space: NASSpace,
+        *,
+        w_lr: float = 1e-2,
+        ctrl_lr: float = 3e-3,
+        entropy_coef: float = 1e-3,
+        baseline_decay: float = 0.8,
+        seed: int = 0,
+    ):
+        self.space = space
+        self.net = SuperNet(space, weights_are_probs=True)
+        self.controller = ControllerNet(space)
+        rng = jax.random.PRNGKey(seed)
+        r_w, r_c, self._rng = jax.random.split(rng, 3)
+        n_edges, n_ops = len(space.edges), len(space.ops)
+        dummy_alpha = jnp.zeros((n_edges, n_ops))
+        dummy = jnp.zeros((1, *space.input_shape))
+        self.w = self.net.init(r_w, dummy, dummy_alpha)
+        self.ctrl = self.controller.init(r_c, jax.random.PRNGKey(0))
+        self.w_opt = optax.adam(w_lr)
+        self.c_opt = optax.adam(ctrl_lr)
+        self.w_state = self.w_opt.init(self.w)
+        self.c_state = self.c_opt.init(self.ctrl)
+        self.entropy_coef = entropy_coef
+        self.baseline_decay = baseline_decay
+        self.baseline = 0.0
+
+        #: edge index lookup: (from, to) → position in space.edges
+        self._edge_idx = {e: n for n, e in enumerate(space.edges)}
+
+        def arch_weights(inputs, ops):
+            """Sampled decisions → hard [n_edges, n_ops] mixing weights.
+            Unselected edges are all-zero rows; a node picking the same
+            input twice keeps weight 1 (jnp.maximum, not sum)."""
+            A = jnp.zeros((n_edges, n_ops))
+            for j in range(1, space.nodes + 1):
+                for slot in range(2):
+                    i, o = inputs[j - 1, slot], ops[j - 1, slot]
+                    # one-hot over the incoming edges of node j
+                    for src in range(j):
+                        e = self._edge_idx[(src, j)]
+                        A = A.at[e].max(
+                            (i == src) * jax.nn.one_hot(o, n_ops)
+                        )
+            return A
+
+        self._arch_weights = arch_weights
+
+        def w_step(w, w_state, ctrl, rng, batch):
+            inputs, ops, _, _ = self.controller.apply(ctrl, rng)
+            A = arch_weights(inputs, ops)
+
+            def loss_fn(w):
+                logits = self.net.apply(w, batch["image"], A)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch["label"]
+                ).mean()
+
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            updates, w_state = self.w_opt.update(g, w_state, w)
+            return optax.apply_updates(w, updates), w_state, loss
+
+        def ctrl_step(ctrl, c_state, w, rng, batch, baseline):
+            def loss_fn(ctrl):
+                inputs, ops, logp, entropy = self.controller.apply(ctrl, rng)
+                A = arch_weights(inputs, ops)
+                logits = self.net.apply(w, batch["image"], A)
+                acc = (jnp.argmax(logits, -1) == batch["label"]).mean()
+                reward = jax.lax.stop_gradient(acc)
+                loss = (
+                    -(reward - baseline) * logp
+                    - self.entropy_coef * entropy
+                )
+                return loss, reward
+
+            (loss, reward), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                ctrl
+            )
+            updates, c_state = self.c_opt.update(g, c_state, ctrl)
+            return optax.apply_updates(ctrl, updates), c_state, loss, reward
+
+        self._w_step = jax.jit(w_step)
+        self._ctrl_step = jax.jit(ctrl_step)
+        self._greedy = jax.jit(
+            lambda ctrl, rng: self.controller.apply(ctrl, rng, greedy=True)
+        )
+
+    def step(
+        self,
+        train_batch: Mapping[str, Any],
+        val_batch: Mapping[str, Any],
+    ) -> dict[str, float]:
+        """One ENAS iteration: shared-weight step on a sampled path, then
+        a REINFORCE controller step on validation reward."""
+        self._rng, k1, k2 = jax.random.split(self._rng, 3)
+        self.w, self.w_state, w_loss = self._w_step(
+            self.w, self.w_state, self.ctrl, k1, train_batch
+        )
+        self.ctrl, self.c_state, c_loss, reward = self._ctrl_step(
+            self.ctrl, self.c_state, self.w, k2, val_batch, self.baseline
+        )
+        reward = float(reward)
+        d = self.baseline_decay
+        self.baseline = d * self.baseline + (1 - d) * reward
+        return {
+            "w_loss": float(w_loss),
+            "ctrl_loss": float(c_loss),
+            "reward": reward,
+            "baseline": self.baseline,
+        }
+
+    def search(
+        self,
+        data: Callable[[int], tuple[Mapping[str, Any], Mapping[str, Any]]],
+        steps: int,
+    ) -> DerivedCell:
+        for i in range(steps):
+            train_batch, val_batch = data(i)
+            self.step(train_batch, val_batch)
+        return self.derive()
+
+    def derive(self) -> DerivedCell:
+        """Greedy (argmax) controller rollout → discrete cell, same
+        DerivedCell shape the DARTS searcher emits."""
+        inputs, ops, _, _ = self._greedy(self.ctrl, jax.random.PRNGKey(0))
+        inputs, ops = np.asarray(inputs), np.asarray(ops)
+        edges: list[tuple[int, int, str]] = []
+        for j in range(1, self.space.nodes + 1):
+            seen: set[tuple[int, str]] = set()
+            for slot in range(2):
+                i = int(inputs[j - 1, slot])
+                op = self.space.ops[int(ops[j - 1, slot])]
+                # same input with DIFFERENT ops is a real architecture the
+                # reward was measured on (the edge computes op_a + op_b) —
+                # keep both; only an exact duplicate collapses
+                if (i, op) in seen:
+                    continue
+                seen.add((i, op))
+                edges.append((i, j, op))
+        return DerivedCell(edges=edges)

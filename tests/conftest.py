@@ -41,3 +41,28 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+def wait_for_job_step(cluster, uid, step, timeout=240):
+    """Poll worker-0 stdout until ``step=N`` appears (any attempt) —
+    shared by the elastic and autoscaler e2e tests."""
+    import time as _time
+
+    from kubeflow_tpu.train.metrics import parse_stdout_metrics
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        if any(
+            m["step"] >= step
+            for m in parse_stdout_metrics(cluster.logs(uid, "worker", 0))
+        ):
+            return
+        if cluster.status(uid).finished:
+            raise AssertionError(
+                f"job finished before reaching step {step}:\n"
+                + cluster.logs(uid, "worker", 0)
+            )
+        _time.sleep(0.2)
+    raise TimeoutError(
+        f"step {step} not reached; log:\n" + cluster.logs(uid, "worker", 0)
+    )

@@ -175,18 +175,7 @@ def test_autoscaler_resizes_real_job_through_checkpoint(tmp_path):
     )
     from kubeflow_tpu.train.metrics import parse_stdout_metrics
 
-    def wait_for_step(cluster, uid, step, timeout=240):
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            if any(
-                m["step"] >= step
-                for m in parse_stdout_metrics(cluster.logs(uid, "worker", 0))
-            ):
-                return
-            if cluster.status(uid).finished:
-                raise AssertionError("job finished early")
-            time.sleep(0.2)
-        raise TimeoutError(f"step {step} not reached")
+    from conftest import wait_for_job_step as wait_for_step
 
     cluster = LocalCluster(
         fleet=Fleet.homogeneous(2, "2x2"),

@@ -321,23 +321,7 @@ def _mnist_job(tmp_path, *, replicas, steps, elastic=None, name="mnist"):
     )
 
 
-def _wait_for_step(cluster, uid, step, timeout=240):
-    """Poll worker-0 stdout until ``step=N`` appears (any attempt)."""
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if any(
-            m["step"] >= step
-            for m in parse_stdout_metrics(cluster.logs(uid, "worker", 0))
-        ):
-            return
-        if cluster.status(uid).finished:
-            raise AssertionError(
-                f"job finished before reaching step {step}:\n"
-                + cluster.logs(uid, "worker", 0)
-            )
-        time.sleep(0.2)
-    raise TimeoutError(f"step {step} not reached; log:\n"
-                       + cluster.logs(uid, "worker", 0))
+from conftest import wait_for_job_step as _wait_for_step  # noqa: E402
 
 
 @pytest.mark.slow
