@@ -67,6 +67,7 @@ def _cmd_run(args) -> int:
     from kubeflow_tpu.orchestrator.resources import Fleet
     from kubeflow_tpu.orchestrator.spec import JobConditionType, JobSpec
     from kubeflow_tpu.platform import manifests
+    from kubeflow_tpu.platform.volumes import VolumeSpec
     from kubeflow_tpu.tune.spec import ExperimentSpec
 
     jobs: list[JobSpec] = []
@@ -91,6 +92,8 @@ def _cmd_run(args) -> int:
         elif isinstance(parsed, ExperimentSpec):
             experiments.append(parsed)
         elif isinstance(parsed, dict):  # ConfigMap — nothing to run
+            continue
+        elif isinstance(parsed, VolumeSpec):  # PVC — nothing to run
             continue
         else:
             print(

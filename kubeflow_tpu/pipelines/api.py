@@ -47,6 +47,9 @@ class _RunRecord:
     parameters: dict[str, Any] = dataclasses.field(default_factory=dict)
     #: shared with the runner (mutated in place while the run executes)
     tasks: dict[str, TaskResult] = dataclasses.field(default_factory=dict)
+    #: the run's DAG structure, captured at submit (inline specs have no
+    #: registry entry to consult later): [{name, component, deps}]
+    dag: list[dict] = dataclasses.field(default_factory=list)
     result: RunResult | None = None
     error: str = ""
 
@@ -133,7 +136,17 @@ class PipelineAPIServer(ThreadedAiohttpServer):
     def create_run(self, ir: PipelineIR, parameters: dict[str, Any]) -> str:
         resolve_parameters(ir, parameters)  # fail fast at submit time
         rid = uuid.uuid4().hex[:12]
-        rec = _RunRecord(run_id=rid, pipeline=ir.name, parameters=parameters)
+        rec = _RunRecord(
+            run_id=rid, pipeline=ir.name, parameters=parameters,
+            dag=[
+                {
+                    "name": t.name,
+                    "component": t.component,
+                    "deps": sorted(t.deps()),
+                }
+                for t in ir.tasks
+            ],
+        )
         with self._lock:
             self._runs[rid] = rec
 
@@ -157,6 +170,30 @@ class PipelineAPIServer(ThreadedAiohttpServer):
             if run_id not in self._runs:
                 raise KeyError(f"run {run_id!r} not found")
             return self._runs[run_id]
+
+    def run_dag(self, run_id: str) -> dict:
+        """DAG structure + live task states — the dashboard's pipeline
+        graph view (SURVEY.md §2.4 frontend row)."""
+        rec = self.get_run(run_id)
+        return {
+            "run_id": rec.run_id,
+            "pipeline": rec.pipeline,
+            "state": rec.state,
+            "tasks": [
+                {
+                    **node,
+                    "state": (
+                        rec.tasks[node["name"]].state
+                        if node["name"] in rec.tasks else "PENDING"
+                    ),
+                    "cache_hit": (
+                        rec.tasks[node["name"]].cache_hit
+                        if node["name"] in rec.tasks else False
+                    ),
+                }
+                for node in rec.dag
+            ],
+        }
 
     # -- HTTP surface -------------------------------------------------------- #
 
@@ -233,6 +270,9 @@ class PipelineAPIServer(ThreadedAiohttpServer):
         async def get_run(request):
             return self.get_run(request.match_info["run_id"]).to_dict()
 
+        async def get_run_dag(request):
+            return self.run_dag(request.match_info["run_id"])
+
         async def create_recurring(request):
             body = await request.json()
             ir = self._resolve_spec(body)
@@ -305,6 +345,7 @@ class PipelineAPIServer(ThreadedAiohttpServer):
         app.router.add_post(f"{pfx}/runs", guard(create_run))
         app.router.add_get(f"{pfx}/runs", guard(list_runs))
         app.router.add_get(f"{pfx}/runs/{{run_id}}", guard(get_run))
+        app.router.add_get(f"{pfx}/runs/{{run_id}}/dag", guard(get_run_dag))
         app.router.add_post(f"{pfx}/recurringruns", guard(create_recurring))
         app.router.add_get(f"{pfx}/recurringruns", guard(list_recurring))
         app.router.add_get(

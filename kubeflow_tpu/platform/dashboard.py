@@ -55,6 +55,8 @@ class DashboardServer(ThreadedAiohttpServer):
         tensorboards: TensorboardController | None = None,
         tune_db=None,       # tune.db.TrialDB → /api/experiments (Katib UI)
         lineage=None,       # pipelines.metadata.LineageStore → /api/pipelines
+        pipeline_api=None,  # pipelines.api.PipelineAPIServer → DAG view
+        volumes=None,       # platform.volumes.VolumeController → /api/volumes
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -65,6 +67,8 @@ class DashboardServer(ThreadedAiohttpServer):
         self.tensorboards = tensorboards
         self.tune_db = tune_db
         self.lineage = lineage
+        self.pipeline_api = pipeline_api
+        self.volumes = volumes
 
     # -- views ---------------------------------------------------------- #
 
@@ -133,6 +137,21 @@ class DashboardServer(ThreadedAiohttpServer):
             for spec, status in self.tensorboards.statuses()
         ]
 
+    def volumes_view(self) -> list[dict]:
+        if self.volumes is None:
+            return []
+        return [
+            {
+                "name": spec.name,
+                "namespace": spec.namespace,
+                "phase": status.phase,
+                "size_mb": spec.size_mb,
+                "used_mb": used,
+                "bound_to": sorted(status.bound_to),
+            }
+            for spec, status, used in self.volumes.statuses()
+        ]
+
     def experiments_view(self) -> list[dict]:
         return [] if self.tune_db is None else self.tune_db.experiments()
 
@@ -156,6 +175,17 @@ class DashboardServer(ThreadedAiohttpServer):
     def pipeline_tasks_view(self, run_id: str) -> list[dict]:
         return [] if self.lineage is None else self.lineage.executions(run_id)
 
+    def pipeline_dag_view(self, run_id: str) -> dict:
+        """DAG structure + live task states via the pipelines API server
+        (which captured the spec at submit); {} when not wired or the run
+        predates the API."""
+        if self.pipeline_api is None:
+            return {}
+        try:
+            return self.pipeline_api.run_dag(run_id)
+        except KeyError:
+            return {}
+
     def summary_view(self) -> dict:
         jobs = self.jobs_view()
         phases: dict[str, int] = {}
@@ -165,6 +195,9 @@ class DashboardServer(ThreadedAiohttpServer):
             "jobs": {"total": len(jobs), "by_phase": phases},
             "profiles": len(self.profiles_view()),
             "notebooks": len(self.notebooks_view()),
+            # count() not volumes_view(): the view walks every volume's
+            # tree for usage; the summary poll only needs the integer
+            "volumes": 0 if self.volumes is None else self.volumes.count(),
             "tensorboards": len(self.tensorboards_view()),
             "experiments": len(self.experiments_view()),
             "pipeline_runs": len(self.pipelines_view()),
@@ -203,10 +236,19 @@ class DashboardServer(ThreadedAiohttpServer):
         # ---- CRUD: jobs ------------------------------------------------ #
 
         async def create_job(request):
+            from kubeflow_tpu.orchestrator.spec import JobSpec
             from kubeflow_tpu.platform.manifests import parse
 
             manifest = await request.json()
             spec = parse(manifest)
+            if not isinstance(spec, JobSpec):
+                # parse() knows more kinds than are submittable here (PVC,
+                # InferenceService…): a clean 400, not an AttributeError
+                # 500 from cluster.submit
+                raise ValueError(
+                    f"manifest kind {manifest.get('kind')!r} is not a "
+                    "runnable job"
+                )
             uid = self.cluster.submit(spec)
             return web.json_response({"uid": uid, "name": spec.name})
 
@@ -264,6 +306,31 @@ class DashboardServer(ThreadedAiohttpServer):
             if self.notebooks is None:
                 raise ValueError("notebook controller not attached")
             self.notebooks.delete(request.match_info["name"])
+            return web.json_response({"deleted": request.match_info["name"]})
+
+        # ---- CRUD: volumes (PVC web app analog) ------------------------ #
+
+        async def create_volume(request):
+            from kubeflow_tpu.platform.volumes import VolumeSpec
+
+            if self.volumes is None:
+                raise ValueError("volume controller not attached")
+            body = await request.json()
+            spec = VolumeSpec(
+                name=valid_name(body["name"]),
+                namespace=body.get("namespace", "default"),
+                size_mb=int(body.get("size_mb", 1024)),
+            )
+            # spec.validate() (inside create) DNS-1123-checks the
+            # namespace too — it is a path component
+            path = self.volumes.create(spec)
+            return web.json_response({"name": spec.name, "path": path})
+
+        async def delete_volume(request):
+            if self.volumes is None:
+                raise ValueError("volume controller not attached")
+            ns = request.query.get("namespace", "default")
+            self.volumes.delete(request.match_info["name"], ns)
             return web.json_response({"deleted": request.match_info["name"]})
 
         # ---- CRUD: tensorboards ---------------------------------------- #
@@ -344,11 +411,22 @@ class DashboardServer(ThreadedAiohttpServer):
                 )
             ),
         )
+        app.router.add_get(
+            "/api/pipelines/{run_id}/dag",
+            guard(
+                lambda r: _json(
+                    self.pipeline_dag_view(r.match_info["run_id"])
+                )
+            ),
+        )
         app.router.add_post("/api/jobs", guard(create_job))
         app.router.add_delete("/api/jobs/{uid}", guard(delete_job))
         app.router.add_get("/api/jobs/{uid}/logs", guard(job_logs))
         app.router.add_post("/api/notebooks", guard(create_notebook))
         app.router.add_delete("/api/notebooks/{name}", guard(delete_notebook))
+        app.router.add_get("/api/volumes", handler(self.volumes_view))
+        app.router.add_post("/api/volumes", guard(create_volume))
+        app.router.add_delete("/api/volumes/{name}", guard(delete_volume))
         app.router.add_post("/api/tensorboards", guard(create_tensorboard))
         app.router.add_delete(
             "/api/tensorboards/{name}", guard(delete_tensorboard)
@@ -385,7 +463,7 @@ _INDEX_HTML = """<!doctype html>
 <header><h1>kubeflow-tpu</h1><nav id="nav"></nav></header>
 <main id="main"></main>
 <script>
-const tabs=["summary","jobs","experiments","pipelines","notebooks","tensorboards","profiles"];
+const tabs=["summary","jobs","experiments","pipelines","notebooks","volumes","tensorboards","profiles"];
 let tab="summary";
 const $=(h)=>{const d=document.createElement("div");d.innerHTML=h;return d};
 const esc=(s)=>String(s).replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
@@ -427,12 +505,19 @@ async function render(){nav();const m=document.getElementById("main");m.textCont
   m.innerHTML=table(rows,["name","trials","succeeded","failed","running"])+`<pre id="detail" hidden></pre>`}
  if(tab==="pipelines"){const rows=(await j("/api/pipelines")).map(r=>({...r,state:pill(r.state),
    run_id:raw(`<a href="#" onclick="tasks('${uenc(r.run_id)}');return false">${esc(r.run_id)}</a>`)}));
-  m.innerHTML=table(rows,["run_id","state","tasks","succeeded","failed","cache_hits"])+`<pre id="detail" hidden></pre>`}
+  m.innerHTML=table(rows,["run_id","state","tasks","succeeded","failed","cache_hits"])+
+   `<div id="dag" hidden style="background:#fff;border:1px solid #e4e7ec;margin-top:10px;overflow:auto"></div><pre id="detail" hidden></pre>`}
  if(tab==="notebooks"){const rows=(await j("/api/notebooks")).map(r=>({...r,phase:pill(r.phase)}));
   m.innerHTML=`<div class="bar"><input id="nb" placeholder="name">
     <button class="act" onclick="mknb()">create notebook</button></div>`+
    table(rows,["name","namespace","phase","idle_seconds"],
     r=>`<button class="act" onclick="del('/api/notebooks/${uenc(r.name)}')">delete</button>`)}
+ if(tab==="volumes"){const rows=(await j("/api/volumes")).map(r=>({...r,phase:pill(r.phase),
+   bound_to:r.bound_to.join(", ")}));
+  m.innerHTML=`<div class="bar"><input id="vn" placeholder="name"><input id="vs" placeholder="size MB" size="7">
+    <button class="act" onclick="mkvol()">create volume</button></div>`+
+   table(rows,["name","namespace","phase","size_mb","used_mb","bound_to"],
+    r=>`<button class="act" onclick="del('/api/volumes/${uenc(r.name)}')">delete</button>`)}
  if(tab==="tensorboards"){const rows=(await j("/api/tensorboards")).map(r=>({...r,phase:pill(r.phase),
    url:raw(`<a href="${esc(r.url)}">${esc(r.url)}</a>`)}));
   m.innerHTML=`<div class="bar"><input id="tbn" placeholder="name"><input id="tbl" placeholder="logdir">
@@ -449,10 +534,43 @@ async function logs(uid){const p=document.getElementById("logs");p.hidden=false;
 async function trials(name){const p=document.getElementById("detail");p.hidden=false;
  p.textContent=JSON.stringify(await j(`/api/experiments/${name}/trials`),null,1)}
 async function tasks(run){const p=document.getElementById("detail");p.hidden=false;
+ const g=document.getElementById("dag");
+ try{const dag=await j(`/api/pipelines/${run}/dag`);
+  if(dag&&dag.tasks&&dag.tasks.length){g.hidden=false;g.innerHTML=drawDag(dag.tasks)}
+  else g.hidden=true}catch(e){g.hidden=true}
  p.textContent=JSON.stringify(await j(`/api/pipelines/${run}/tasks`),null,1)}
+// layered DAG render: depth = longest dependency path, one column per
+// depth, SVG boxes colored by task state (the KFP run-graph analog)
+function drawDag(ts){const byName={};ts.forEach(t=>byName[t.name]=t);
+ const depth={};const d=(n)=>{if(depth[n]!==undefined)return depth[n];
+  const t=byName[n];if(!t)return 0;
+  depth[n]=t.deps.length?1+Math.max(...t.deps.map(d)):0;return depth[n]};
+ ts.forEach(t=>d(t.name));
+ const layers={};ts.forEach(t=>{(layers[depth[t.name]]=layers[depth[t.name]]||[]).push(t)});
+ const W=150,H=44,GX=70,GY=16,pos={};
+ Object.keys(layers).sort((a,b)=>a-b).forEach(ly=>layers[ly].forEach((t,i)=>
+  pos[t.name]={x:ly*(W+GX)+12,y:i*(H+GY)+12}));
+ const xs=Object.values(pos),maxX=Math.max(...xs.map(p=>p.x))+W+12,
+  maxY=Math.max(...xs.map(p=>p.y))+H+12;
+ const fill={SUCCEEDED:"#d7f5dd",RUNNING:"#d7e9f9",FAILED:"#fadcd9",
+  SKIPPED:"#e4e7ec",PENDING:"#faf0d2"};
+ let s=`<svg width="${maxX}" height="${maxY}" xmlns="http://www.w3.org/2000/svg">`+
+  `<defs><marker id="arr" markerWidth="8" markerHeight="8" refX="7" refY="3" orient="auto">`+
+  `<path d="M0 0 L7 3 L0 6 z" fill="#8a94a6"/></marker></defs>`;
+ ts.forEach(t=>t.deps.forEach(dep=>{const a=pos[dep],b=pos[t.name];if(!a||!b)return;
+  s+=`<path d="M${a.x+W} ${a.y+H/2} C ${a.x+W+GX/2} ${a.y+H/2}, ${b.x-GX/2} ${b.y+H/2}, ${b.x-2} ${b.y+H/2}" stroke="#8a94a6" fill="none" marker-end="url(#arr)"/>`}));
+ ts.forEach(t=>{const p=pos[t.name];
+  s+=`<rect x="${p.x}" y="${p.y}" width="${W}" height="${H}" rx="6" fill="${fill[t.state]||"#fff"}" stroke="#8a94a6"/>`+
+  `<text x="${p.x+8}" y="${p.y+18}" font-size="12" font-weight="600">${esc(t.name)}${t.cache_hit?" ⚡":""}</text>`+
+  `<text x="${p.x+8}" y="${p.y+34}" font-size="10" fill="#444">${esc(t.state)}</text>`});
+ return s+"</svg>"}
 async function mknb(){await j("/api/notebooks",{method:"POST",
  headers:{"content-type":"application/json"},
  body:JSON.stringify({name:document.getElementById("nb").value})});render()}
+async function mkvol(){await j("/api/volumes",{method:"POST",
+ headers:{"content-type":"application/json"},
+ body:JSON.stringify({name:document.getElementById("vn").value,
+  size_mb:parseInt(document.getElementById("vs").value||"1024")})});render()}
 async function mktb(){await j("/api/tensorboards",{method:"POST",
  headers:{"content-type":"application/json"},
  body:JSON.stringify({name:document.getElementById("tbn").value,

@@ -252,6 +252,10 @@ def parse(manifest: Mapping[str, Any]) -> Any:
             {"name": manifest.get("metadata", {}).get("name"),
              **manifest.get("spec", {})}
         )
+    if kind == "PersistentVolumeClaim":
+        from kubeflow_tpu.platform.volumes import VolumeSpec
+
+        return VolumeSpec.from_manifest(manifest)
     if kind == "ConfigMap":
         return dict(manifest)
     raise UnsupportedKind(f"no parser for manifest kind {kind!r}")

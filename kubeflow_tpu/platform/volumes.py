@@ -1,0 +1,225 @@
+"""Volumes: durable named workspaces — the PVC + volumes web app analog.
+
+Reference analog (SURVEY.md §2.5 CRUD-web-apps row: the volumes app
+creates/lists/deletes PersistentVolumeClaims for notebooks and jobs —
+UNVERIFIED, mount empty, §0). Without a storage provisioner, a volume is
+a managed directory under one root with a soft capacity quota: creation
+is atomic, usage is measured (the PVC "requested vs used" columns),
+deletion refuses while any notebook or job references the volume (the
+`kubernetes.io/pvc-protection` finalizer analog), and a `mount()` hands
+a consumer the path + env wiring (``KFT_VOLUME_<NAME>``) so processes
+find their volumes the same way containers find mount paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import shutil
+import threading
+import time
+
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")  # DNS-1123
+
+
+@dataclasses.dataclass(frozen=True)
+class VolumeSpec:
+    name: str
+    namespace: str = "default"
+    size_mb: int = 1024            # soft quota, enforced at usage checks
+
+    def validate(self) -> None:
+        # BOTH name and namespace become path components under the managed
+        # root — DNS-1123 validation is also the path-traversal guard
+        # ('../../x' must never reach os.path.join)
+        if not _NAME_RE.match(self.name):
+            raise ValueError(
+                f"volume name {self.name!r} must be DNS-1123 (lowercase "
+                "alphanumerics and '-')"
+            )
+        if not _NAME_RE.match(self.namespace):
+            raise ValueError(
+                f"volume namespace {self.namespace!r} must be DNS-1123"
+            )
+        if self.size_mb < 1:
+            raise ValueError(f"size_mb must be >= 1, got {self.size_mb}")
+
+    @classmethod
+    def from_manifest(cls, doc) -> "VolumeSpec":
+        """Accepts the PVC manifest shape 1:1: metadata.name/namespace +
+        spec.resources.requests.storage ('1Gi', '512Mi')."""
+        meta = doc.get("metadata", {})
+        storage = (
+            doc.get("spec", {})
+            .get("resources", {})
+            .get("requests", {})
+            .get("storage", "1Gi")
+        )
+        m = re.fullmatch(r"(\d+)(Gi|Mi)", str(storage))
+        if not m:
+            raise ValueError(
+                f"unsupported storage quantity {storage!r} (use NGi/NMi)"
+            )
+        size_mb = int(m.group(1)) * (1024 if m.group(2) == "Gi" else 1)
+        spec = cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            size_mb=size_mb,
+        )
+        spec.validate()
+        return spec
+
+
+@dataclasses.dataclass
+class VolumeStatus:
+    phase: str = "Bound"           # PVCs here bind immediately
+    created_at: float = dataclasses.field(default_factory=time.time)
+    #: consumers holding the volume (notebook/job names) — deletion
+    #: protection while non-empty
+    bound_to: set[str] = dataclasses.field(default_factory=set)
+
+
+class VolumeController:
+    """CRUD + mount wiring over one managed root directory."""
+
+    _META = ".kft-volume.json"
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.RLock()
+        self._volumes: dict[tuple[str, str], tuple[VolumeSpec, VolumeStatus]] = {}
+        self._recover()
+
+    def _recover(self) -> None:
+        """Volumes are DURABLE directories; re-register what survives a
+        process restart (each carries its spec in a meta file)."""
+        import json
+
+        for ns in sorted(os.listdir(self.root)):
+            ns_dir = os.path.join(self.root, ns)
+            if not os.path.isdir(ns_dir):
+                continue
+            for name in sorted(os.listdir(ns_dir)):
+                meta = os.path.join(ns_dir, name, self._META)
+                if not os.path.isfile(meta):
+                    continue
+                try:
+                    with open(meta) as f:
+                        doc = json.load(f)
+                    spec = VolumeSpec(
+                        name=name, namespace=ns,
+                        size_mb=int(doc.get("size_mb", 1024)),
+                    )
+                    spec.validate()
+                except (OSError, ValueError, TypeError):
+                    continue  # corrupt meta: leave the dir, don't serve it
+                self._volumes[(ns, name)] = (spec, VolumeStatus())
+
+    # -- CRUD ----------------------------------------------------------- #
+
+    def create(self, spec: VolumeSpec) -> str:
+        import json
+
+        spec.validate()
+        key = (spec.namespace, spec.name)
+        with self._lock:
+            if key in self._volumes:
+                raise ValueError(
+                    f"volume {spec.namespace}/{spec.name} already exists"
+                )
+            path = self.path(spec.namespace, spec.name)
+            try:
+                os.makedirs(path, exist_ok=False)
+            except FileExistsError:
+                # a directory without a registered volume (pre-restart
+                # leftover with corrupt meta): surface as the same
+                # already-exists contract, not a 500
+                raise ValueError(
+                    f"volume {spec.namespace}/{spec.name} already exists "
+                    "on disk"
+                ) from None
+            with open(os.path.join(path, self._META), "w") as f:
+                json.dump({"size_mb": spec.size_mb}, f)
+            self._volumes[key] = (spec, VolumeStatus())
+            return path
+
+    def path(self, namespace: str, name: str) -> str:
+        # belt-and-braces beyond validate(): never join a traversal
+        if not _NAME_RE.match(namespace) or not _NAME_RE.match(name):
+            raise ValueError(f"bad volume path {namespace!r}/{name!r}")
+        return os.path.join(self.root, namespace, name)
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._volumes)
+
+    def get(self, name: str, namespace: str = "default") -> VolumeSpec:
+        with self._lock:
+            if (namespace, name) not in self._volumes:
+                raise KeyError(f"volume {namespace}/{name} not found")
+            return self._volumes[(namespace, name)][0]
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            key = (namespace, name)
+            if key not in self._volumes:
+                raise KeyError(f"volume {namespace}/{name} not found")
+            _, status = self._volumes[key]
+            if status.bound_to:
+                # pvc-protection finalizer analog: in-use volumes refuse
+                raise ValueError(
+                    f"volume {namespace}/{name} is in use by "
+                    f"{sorted(status.bound_to)}"
+                )
+            del self._volumes[key]
+            shutil.rmtree(self.path(namespace, name), ignore_errors=True)
+
+    def usage_mb(self, name: str, namespace: str = "default") -> float:
+        path = self.path(namespace, name)
+        total = 0
+        for r, _, files in os.walk(path):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(r, f))
+                except OSError:
+                    pass
+        return total / 2**20
+
+    def statuses(self) -> list[tuple[VolumeSpec, VolumeStatus, float]]:
+        with self._lock:
+            items = list(self._volumes.values())
+        return [
+            (spec, status, round(self.usage_mb(spec.name, spec.namespace), 3))
+            for spec, status in items
+        ]
+
+    # -- mounting -------------------------------------------------------- #
+
+    def mount(
+        self, name: str, consumer: str, namespace: str = "default"
+    ) -> tuple[str, dict[str, str]]:
+        """Bind the volume to ``consumer``; returns (path, env) where env
+        carries ``KFT_VOLUME_<NAME>=path`` — the mount-path contract jobs
+        and notebooks read. Quota: mounting fails once usage exceeds the
+        requested size (the provisioner's out-of-space analog)."""
+        with self._lock:
+            spec = self.get(name, namespace)
+            _, status = self._volumes[(namespace, name)]
+            if self.usage_mb(name, namespace) > spec.size_mb:
+                raise ValueError(
+                    f"volume {namespace}/{name} over quota "
+                    f"({spec.size_mb} MB)"
+                )
+            status.bound_to.add(consumer)
+            path = self.path(namespace, name)
+            env_name = "KFT_VOLUME_" + name.upper().replace("-", "_")
+            return path, {env_name: path}
+
+    def unmount(
+        self, name: str, consumer: str, namespace: str = "default"
+    ) -> None:
+        with self._lock:
+            if (namespace, name) in self._volumes:
+                self._volumes[(namespace, name)][1].bound_to.discard(consumer)

@@ -413,11 +413,110 @@ def _fetch_gs(uri: str, staging: str) -> str:
     )
 
 
+# --------------------------------------------------------------------------- #
+# HDFS: WebHDFS REST (the NameNode's public HTTP gateway)
+# --------------------------------------------------------------------------- #
+
+
+def _webhdfs_endpoint(netloc: str) -> str:
+    """``WEBHDFS_ENDPOINT`` overrides (emulators/tests, or a gateway in
+    front of the cluster); otherwise the uri's namenode host with the
+    default WebHDFS port 9870."""
+    ep = os.environ.get("WEBHDFS_ENDPOINT")
+    if ep:
+        return ep.rstrip("/")
+    host, _, port = netloc.partition(":")
+    if not host:
+        raise PermanentError(
+            "hdfs:// uri needs a namenode host (hdfs://namenode[:port]/path)"
+        )
+    return f"http://{host}:{port or 9870}"
+
+
+def _webhdfs_user_q() -> str:
+    # simple (pseudo) auth, the WebHDFS default: identity rides as a query
+    # parameter; Kerberized clusters front this with a gateway
+    user = os.environ.get("HADOOP_USER_NAME")
+    return f"&user.name={urllib.parse.quote(user)}" if user else ""
+
+
+def _webhdfs_json(endpoint: str, path: str, op: str) -> dict:
+    url = (
+        f"{endpoint}/webhdfs/v1{urllib.parse.quote(path)}?op={op}"
+        + _webhdfs_user_q()
+    )
+    req = urllib.request.Request(url)  # noqa: S310
+    with _open(req, 60.0) as resp:
+        return json.loads(resp.read())
+
+
+def _webhdfs_walk(endpoint: str, path: str) -> list[str]:
+    """Every FILE path under ``path``, recursive LISTSTATUS."""
+    out: list[str] = []
+    stack = [path.rstrip("/") or "/"]
+    while stack:
+        cur = stack.pop()
+        statuses = _webhdfs_json(endpoint, cur, "LISTSTATUS")[
+            "FileStatuses"
+        ]["FileStatus"]
+        for st in statuses:
+            child = (
+                f"{cur.rstrip('/')}/{st['pathSuffix']}"
+                if st["pathSuffix"] else cur
+            )
+            if st["type"] == "DIRECTORY":
+                stack.append(child)
+            else:
+                out.append(child)
+    return out
+
+
+def _fetch_hdfs(uri: str, staging: str) -> str:
+    """hdfs://namenode[:port]/path → WebHDFS: GETFILESTATUS to classify,
+    LISTSTATUS to walk directories, OPEN for bytes (urllib follows the
+    NameNode→DataNode 307 redirect; mid-stream failures resume through
+    http_get_to_file's Range machinery)."""
+    p = urllib.parse.urlparse(uri)
+    endpoint = _webhdfs_endpoint(p.netloc)
+    path = p.path or "/"
+
+    def open_url(fp: str) -> str:
+        return (
+            f"{endpoint}/webhdfs/v1{urllib.parse.quote(fp)}?op=OPEN"
+            + _webhdfs_user_q()
+        )
+
+    try:
+        st = _webhdfs_json(endpoint, path, "GETFILESTATUS")["FileStatus"]
+    except PermanentError as e:
+        cause = e.__cause__
+        if isinstance(cause, urllib.error.HTTPError) and cause.code == 404:
+            raise PermanentError(
+                f"hdfs://{p.netloc}{path}: no such file or directory"
+            ) from e
+        raise
+    if st["type"] == "FILE":
+        name = os.path.basename(path.rstrip("/")) or "model"
+        return http_get_to_file(open_url(path), os.path.join(staging, name))
+    files = _webhdfs_walk(endpoint, path)
+    root = os.path.join(
+        staging, os.path.basename(path.rstrip("/")) or "model"
+    )
+    base = path.rstrip("/") + "/"
+    os.makedirs(root, exist_ok=True)
+    for fp in files:
+        local = os.path.join(root, fp[len(base):])
+        os.makedirs(os.path.dirname(local), exist_ok=True)
+        http_get_to_file(open_url(fp), local)
+    return root
+
+
 def register_all() -> None:
     storage.register_fetcher("http", _fetch_http)
     storage.register_fetcher("https", _fetch_http)
     storage.register_fetcher("s3", _fetch_s3)
     storage.register_fetcher("gs", _fetch_gs)
+    storage.register_fetcher("hdfs", _fetch_hdfs)
 
 
 register_all()

@@ -245,6 +245,16 @@ def default_registry() -> RuntimeRegistry:
             priority=1,
         )
     )
+    from kubeflow_tpu.serve.lightgbm_runtime import LightGBMRuntimeModel
+
+    reg.register(
+        ServingRuntime(
+            name="kubeflow-tpu-lightgbm",
+            supported_formats=("lightgbm",),
+            factory=LightGBMRuntimeModel,
+            priority=1,
+        )
+    )
     reg.register(
         ServingRuntime(
             name="kubeflow-tpu-sklearn",

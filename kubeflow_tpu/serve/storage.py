@@ -163,7 +163,9 @@ def download(
                 staged = _fetch_file(rest, staging)
             else:
                 fetcher = _FETCHERS.get(scheme)
-                if fetcher is None and scheme in ("http", "https", "s3", "gs"):
+                if fetcher is None and scheme in (
+                    "http", "https", "s3", "gs", "hdfs"
+                ):
                     from . import cloudstorage  # noqa: F401  (self-registers)
 
                     fetcher = _FETCHERS.get(scheme)

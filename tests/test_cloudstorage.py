@@ -476,3 +476,106 @@ def test_resume_at_eof_416_completes(tmp_path):
         )
     assert open(dest, "rb").read() == data
     assert state["calls"] >= 2  # the 416 resume round-trip happened
+
+
+# --------------------------------------------------------------------- hdfs
+
+
+def _webhdfs_app(tree: dict[str, bytes], seen: dict):
+    """A NameNode speaking the three WebHDFS ops the fetcher uses, with
+    OPEN answering via a 307 redirect to a 'datanode' route (the real
+    protocol shape)."""
+
+    def classify(path):
+        path = "/" + path.strip("/")
+        if path.strip("/") in {k.rsplit("/", 1)[0] for k in tree} or any(
+            k.startswith(path.strip("/") + "/") for k in tree
+        ):
+            return "DIRECTORY"
+        if path.strip("/") in tree:
+            return "FILE"
+        return None
+
+    async def api(request: web.Request):
+        path = request.match_info["path"]
+        op = request.query.get("op")
+        seen.setdefault("ops", []).append((op, "/" + path))
+        seen.setdefault("users", []).append(request.query.get("user.name"))
+        kind = classify(path)
+        if op == "GETFILESTATUS":
+            if kind is None:
+                raise web.HTTPNotFound()
+            return web.json_response({"FileStatus": {
+                "type": kind, "pathSuffix": "", "length": 0}})
+        if op == "LISTSTATUS":
+            base = path.strip("/")
+            names = {}
+            for k in tree:
+                if not k.startswith(base + "/"):
+                    continue
+                head = k[len(base) + 1:].split("/", 1)[0]
+                names[head] = (
+                    "DIRECTORY" if "/" in k[len(base) + 1:] else "FILE"
+                )
+            return web.json_response({"FileStatuses": {"FileStatus": [
+                {"pathSuffix": n, "type": t, "length": 0}
+                for n, t in sorted(names.items())
+            ]}})
+        if op == "OPEN":
+            if kind != "FILE":
+                raise web.HTTPNotFound()
+            raise web.HTTPTemporaryRedirect(f"/datanode/{path}")
+        raise web.HTTPBadRequest()
+
+    async def datanode(request: web.Request):
+        key = request.match_info["path"].strip("/")
+        seen.setdefault("datanode", []).append(key)
+        return _range_body(request, tree[key])
+
+    app = web.Application()
+    app.router.add_get("/webhdfs/v1/{path:.+}", api)
+    app.router.add_get("/datanode/{path:.+}", datanode)
+    return app
+
+
+def test_hdfs_directory_download(tmp_path, monkeypatch):
+    tree = {
+        "models/bert/config.json": b'{"hidden": 768}',
+        "models/bert/weights.bin": b"H" * 50_000,
+        "models/bert/vocab/tokens.txt": b"a\nb\n",
+        "models/other/skip.bin": b"no",
+    }
+    seen: dict = {}
+    with _Server(_webhdfs_app(tree, seen)) as srv:
+        monkeypatch.setenv("WEBHDFS_ENDPOINT", f"http://127.0.0.1:{srv.port}")
+        monkeypatch.setenv("HADOOP_USER_NAME", "kft")
+        dest = storage.download(
+            "hdfs://namenode/models/bert", str(tmp_path / "mnt")
+        )
+    import os
+
+    got = sorted(
+        os.path.relpath(os.path.join(r, f), dest)
+        for r, _, fs in os.walk(dest)
+        for f in fs
+    )
+    assert got == ["config.json", "vocab/tokens.txt", "weights.bin"]
+    assert open(os.path.join(dest, "weights.bin"), "rb").read() == b"H" * 50_000
+    assert storage.verify(dest, uri="hdfs://namenode/models/bert")
+    # bytes came through the DataNode redirect; identity rode user.name
+    assert seen["datanode"]
+    assert all(u == "kft" for u in seen["users"])
+
+
+def test_hdfs_single_file_and_missing(tmp_path, monkeypatch):
+    tree = {"models/one.bin": b"single" * 100}
+    with _Server(_webhdfs_app(tree, {})) as srv:
+        monkeypatch.setenv("WEBHDFS_ENDPOINT", f"http://127.0.0.1:{srv.port}")
+        dest = storage.download(
+            "hdfs://nn:9870/models/one.bin", str(tmp_path / "mnt")
+        )
+        assert open(dest, "rb").read() == b"single" * 100
+        with pytest.raises(FileNotFoundError, match="no such file"):
+            storage.download(
+                "hdfs://nn/models/nope.bin", str(tmp_path / "mnt2")
+            )

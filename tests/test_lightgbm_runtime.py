@@ -1,0 +1,217 @@
+"""LightGBM text-checkpoint runtime (serve/lightgbm_runtime.py): the
+device program must match an INDEPENDENT walker implementing LightGBM's
+published traversal semantics (<= thresholds, negative-child leaves,
+per-node decision_type missing handling) on randomly generated boosters
+— the lgbserver row of SURVEY.md §2.2 without a lightgbm dependency."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serve.lightgbm_runtime import (
+    LightGBMRuntimeModel,
+    parse_lightgbm_txt,
+)
+from kubeflow_tpu.serve.xgboost_runtime import build_device_predict
+
+# ---------------------------------------------------------------- generator
+
+
+def _random_tree(rng, n_feat, n_leaves):
+    """Random LightGBM tree in the text format's parallel-array form.
+    Children: >=0 internal index, <0 leaf ref (-k-1). decision_type mixes
+    NaN-missing (8|dl) and None-missing (dl only) nodes."""
+    inner = n_leaves - 1
+    # random topology: grow by splitting a random leaf slot
+    lc, rc = [None] * inner, [None] * inner
+    open_slots = [(0, "l"), (0, "r")]
+    next_internal, next_leaf = 1, 0
+    rng.shuffle(open_slots)
+    while open_slots:
+        node, side = open_slots.pop()
+        # choose: internal (if available) or leaf
+        if next_internal < inner and (
+            rng.random() < 0.5
+            or len(open_slots) + 1 < inner - next_internal + 1
+        ):
+            child = next_internal
+            next_internal += 1
+            new = [(child, "l"), (child, "r")]
+            open_slots.extend(new)
+            rng.shuffle(open_slots)
+        else:
+            child = -(next_leaf + 1)
+            next_leaf += 1
+        if side == "l":
+            lc[node] = child
+        else:
+            rc[node] = child
+    assert next_leaf == n_leaves and next_internal == inner
+    return {
+        "num_leaves": n_leaves,
+        "split_feature": [int(rng.integers(0, n_feat)) for _ in range(inner)],
+        "threshold": [round(float(rng.normal()), 4) for _ in range(inner)],
+        "decision_type": [
+            int(rng.choice([2, 0, 8, 10])) for _ in range(inner)
+        ],
+        "left_child": lc,
+        "right_child": rc,
+        "leaf_value": [round(float(rng.normal()), 4) for _ in range(n_leaves)],
+    }
+
+
+def _to_text(trees, *, objective="regression", num_class=1, n_feat=4):
+    lines = [
+        "tree",
+        "version=v4",
+        f"num_class={num_class}",
+        f"num_tree_per_iteration={num_class}",
+        f"max_feature_idx={n_feat - 1}",
+        f"objective={objective}",
+        "feature_names=" + " ".join(f"f{i}" for i in range(n_feat)),
+        "",
+    ]
+    for i, t in enumerate(trees):
+        lines += [f"Tree={i}", f"num_leaves={t['num_leaves']}", "num_cat=0"]
+        for key in ("split_feature", "threshold", "decision_type",
+                    "left_child", "right_child", "leaf_value"):
+            lines.append(f"{key}=" + " ".join(str(v) for v in t[key]))
+        lines.append("")
+    lines += ["end of trees", ""]
+    return "\n".join(lines)
+
+
+def _oracle_margin(trees, x, num_class=1):
+    """Independent traversal, straight off LightGBM's documented
+    semantics — never touches the runtime's parser or arrays."""
+    out = np.zeros((x.shape[0], num_class))
+    for r in range(x.shape[0]):
+        for ti, t in enumerate(trees):
+            if t["num_leaves"] == 1:
+                out[r, ti % num_class] += t["leaf_value"][0]
+                continue
+            node = 0
+            while node >= 0:
+                v = x[r, t["split_feature"][node]]
+                dt = t["decision_type"][node]
+                if math.isnan(v):
+                    if ((dt >> 2) & 3) == 2:        # NaN-missing node
+                        go_left = bool(dt & 2)
+                    else:                            # None-missing: NaN→0
+                        go_left = 0.0 <= t["threshold"][node]
+                else:
+                    go_left = v <= t["threshold"][node]
+                node = t["left_child" if go_left else "right_child"][node]
+            out[r, ti % num_class] += t["leaf_value"][-node - 1]
+    return out
+
+
+# ------------------------------------------------------------------ parity
+
+
+def _fuzz_once(seed, objective, num_class=1, with_nan=True):
+    rng = np.random.default_rng(seed)
+    n_feat = 5
+    trees = [
+        _random_tree(rng, n_feat, int(rng.integers(2, 9)))
+        for _ in range(4 * num_class)
+    ]
+    text = _to_text(
+        trees, objective=objective, num_class=num_class, n_feat=n_feat
+    )
+    x = rng.normal(size=(32, n_feat)).astype(np.float32)
+    if with_nan:
+        x[rng.random(x.shape) < 0.15] = np.nan
+    return trees, text, x
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_regression_parity_fuzz(tmp_path, seed):
+    trees, text, x = _fuzz_once(seed, "regression")
+    p = tmp_path / "model.txt"
+    p.write_text(text)
+    fwd = build_device_predict(parse_lightgbm_txt(str(p)))
+    got = np.asarray(fwd(x))
+    want = _oracle_margin(trees, x)[:, 0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_binary_and_multiclass_links(tmp_path):
+    trees, text, x = _fuzz_once(7, "binary sigmoid:1")
+    p = tmp_path / "model.txt"
+    p.write_text(text)
+    fwd = build_device_predict(parse_lightgbm_txt(str(p)))
+    want = 1.0 / (1.0 + np.exp(-_oracle_margin(trees, x)[:, 0]))
+    np.testing.assert_allclose(np.asarray(fwd(x)), want, rtol=1e-5, atol=1e-6)
+
+    trees, text, x = _fuzz_once(9, "multiclass num_class:3", num_class=3)
+    (tmp_path / "mc.txt").write_text(text)
+    fwd = build_device_predict(parse_lightgbm_txt(str(tmp_path / "mc.txt")))
+    m = _oracle_margin(trees, x, num_class=3)
+    e = np.exp(m - m.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(
+        np.asarray(fwd(x)), e / e.sum(axis=1, keepdims=True),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_le_boundary_is_exact(tmp_path):
+    """The <= vs < conversion must hold AT the threshold value."""
+    tree = {
+        "num_leaves": 2, "split_feature": [0], "threshold": [1.25],
+        "decision_type": [2], "left_child": [-1], "right_child": [-2],
+        "leaf_value": [10.0, 20.0],
+    }
+    p = tmp_path / "model.txt"
+    p.write_text(_to_text([tree], n_feat=1))
+    fwd = build_device_predict(parse_lightgbm_txt(str(p)))
+    x = np.asarray(
+        [[1.25], [np.nextafter(np.float32(1.25), np.float32(2))], [1.0]],
+        np.float32,
+    )
+    np.testing.assert_allclose(np.asarray(fwd(x)), [10.0, 20.0, 10.0])
+
+
+def test_rejects_unsupported_and_serves_e2e(tmp_path):
+    bad = tmp_path / "bad.txt"
+    bad.write_text(_to_text(
+        [_random_tree(np.random.default_rng(0), 3, 4)], objective="poisson"
+    ))
+    with pytest.raises(RuntimeError, match="not supported"):
+        parse_lightgbm_txt(str(bad))
+
+    cat = _random_tree(np.random.default_rng(1), 3, 4)
+    text = _to_text([cat]).replace("num_cat=0", "num_cat=1")
+    (tmp_path / "cat.txt").write_text(text)
+    with pytest.raises(RuntimeError, match="categorical"):
+        parse_lightgbm_txt(str(tmp_path / "cat.txt"))
+
+    zero_missing = dict(cat, decision_type=[4] * 3)
+    (tmp_path / "zm.txt").write_text(_to_text([zero_missing]))
+    with pytest.raises(RuntimeError, match="zero_as_missing"):
+        parse_lightgbm_txt(str(tmp_path / "zm.txt"))
+
+    # registry → model lifecycle → v1 predict round-trip
+    from kubeflow_tpu.serve.spec import PredictorSpec
+    from kubeflow_tpu.serve.runtimes import default_registry
+
+    trees, text, x = _fuzz_once(3, "regression", with_nan=False)
+    mdir = tmp_path / "mnt"
+    mdir.mkdir()
+    (mdir / "model.txt").write_text(text)
+    rt = default_registry().resolve(
+        PredictorSpec(model_format="lightgbm", storage_uri=f"file://{mdir}")
+    )
+    assert rt.name == "kubeflow-tpu-lightgbm"
+    m = rt.factory("lgb", str(mdir))
+    assert isinstance(m, LightGBMRuntimeModel)
+    m.load()
+    rows = m.preprocess({"instances": x[:3].tolist()})
+    out = m.postprocess(m.predict(rows))
+    np.testing.assert_allclose(
+        out["predictions"], _oracle_margin(trees, x[:3])[:, 0],
+        rtol=1e-5, atol=1e-5,
+    )

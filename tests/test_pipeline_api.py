@@ -116,6 +116,13 @@ def test_upload_run_watch_e2e(api):
     runs = _req("GET", f"{base}/apis/v2beta1/runs")["runs"]
     assert runs[0]["run_id"] == run["run_id"]
 
+    # the DAG view: structure captured at submit + live task states
+    dag = _req("GET", f"{base}/apis/v2beta1/runs/{run['run_id']}/dag")
+    nodes = {t["name"]: t for t in dag["tasks"]}
+    assert nodes["produce"]["deps"] == []
+    assert nodes["consume"]["deps"] == ["produce"]
+    assert all(t["state"] == "SUCCEEDED" for t in dag["tasks"])
+
     # the dashboard's read-only pipelines tab shares this LineageStore:
     # a run submitted over the API is visible there — with the right
     # terminal state (regression: the rollup once matched 'Succeeded'

@@ -482,3 +482,189 @@ def test_dashboard_csrf_guard(cluster):
             raise AssertionError("expected 403")
         except urllib.error.HTTPError as e:
             assert e.code == 403
+
+
+def test_dashboard_pipeline_dag_view(cluster, tmp_path):
+    """The KFP run-graph analog: the dashboard serves the run's DAG
+    (structure + live task states) through a wired PipelineAPIServer,
+    and degrades to {} for unknown runs or when unwired."""
+    from kubeflow_tpu.pipelines import (
+        ArtifactStore,
+        LineageStore,
+        PipelineAPIServer,
+        PipelineRunner,
+        StepCache,
+        compile_pipeline,
+        component,
+        pipeline,
+    )
+
+    @component
+    def left() -> int:
+        return 1
+
+    @component
+    def right() -> int:
+        return 2
+
+    @component
+    def join(a: int, b: int) -> int:
+        return a + b
+
+    @pipeline(name="diamond")
+    def diamond():
+        a = left()
+        b = right()
+        join(a=a.output, b=b.output)
+
+    lineage = LineageStore(str(tmp_path / "l.db"))
+    runner = PipelineRunner(
+        artifact_store=ArtifactStore(str(tmp_path / "a")),
+        cache=StepCache(str(tmp_path / "c")),
+        lineage=lineage,
+    )
+    api = PipelineAPIServer(runner).start()
+    try:
+        rid = api.create_run(compile_pipeline(diamond), {})
+        deadline = time.time() + 60
+        while api.get_run(rid).state in ("PENDING", "RUNNING"):
+            assert time.time() < deadline
+            time.sleep(0.05)
+        with DashboardServer(
+            cluster, lineage=lineage, pipeline_api=api
+        ) as dash:
+            dag = json.loads(
+                urllib.request.urlopen(
+                    dash.url + f"/api/pipelines/{rid}/dag"
+                ).read()
+            )
+            nodes = {t["name"]: t for t in dag["tasks"]}
+            assert nodes["join"]["deps"] == ["left", "right"]
+            assert all(t["state"] == "SUCCEEDED" for t in dag["tasks"])
+            # unknown run → {} (the SPA hides the graph panel)
+            empty = json.loads(
+                urllib.request.urlopen(
+                    dash.url + "/api/pipelines/nope/dag"
+                ).read()
+            )
+            assert empty == {}
+            # the SPA ships the renderer
+            html = urllib.request.urlopen(dash.url + "/").read().decode()
+            assert "drawDag" in html
+    finally:
+        api.stop()
+
+
+def test_volume_controller_crud_and_protection(tmp_path):
+    """PVC analog: create/list/delete with in-use protection, quota at
+    mount, PVC-manifest parsing (SURVEY.md §2.5 volumes app row)."""
+    import os
+
+    from kubeflow_tpu.platform.volumes import VolumeController, VolumeSpec
+
+    vc = VolumeController(str(tmp_path / "vols"))
+    path = vc.create(VolumeSpec(name="data", size_mb=1))
+    assert os.path.isdir(path)
+    with pytest.raises(ValueError, match="already exists"):
+        vc.create(VolumeSpec(name="data"))
+    with pytest.raises(ValueError, match="DNS-1123"):
+        VolumeSpec(name="Bad_Name").validate()
+
+    # mount wires the env contract and protects deletion
+    p, env = vc.mount("data", consumer="nb/alice")
+    assert p == path and env == {"KFT_VOLUME_DATA": path}
+    with pytest.raises(ValueError, match="in use"):
+        vc.delete("data")
+    # quota: exceed 1 MB then try to mount again
+    with open(os.path.join(path, "big.bin"), "wb") as f:
+        f.write(b"x" * (2 * 2**20))
+    with pytest.raises(ValueError, match="over quota"):
+        vc.mount("data", consumer="job/b")
+    vc.unmount("data", consumer="nb/alice")
+    vc.delete("data")
+    assert not os.path.exists(path)
+    with pytest.raises(KeyError):
+        vc.get("data")
+
+    # PVC manifest shape accepted 1:1
+    spec = VolumeSpec.from_manifest({
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "ws", "namespace": "team-a"},
+        "spec": {"resources": {"requests": {"storage": "2Gi"}}},
+    })
+    assert spec.size_mb == 2048 and spec.namespace == "team-a"
+    from kubeflow_tpu.platform import manifests as mfs
+
+    assert mfs.parse({
+        "kind": "PersistentVolumeClaim", "metadata": {"name": "x"},
+        "spec": {"resources": {"requests": {"storage": "512Mi"}}},
+    }).size_mb == 512
+
+
+def test_volume_namespace_traversal_rejected_and_restart_recovers(tmp_path):
+    import os
+
+    from kubeflow_tpu.platform.volumes import VolumeController, VolumeSpec
+
+    root = tmp_path / "vols"
+    vc = VolumeController(str(root))
+    # path traversal via namespace must die at validation, nothing created
+    with pytest.raises(ValueError, match="DNS-1123"):
+        vc.create(VolumeSpec(name="evil", namespace="../../outside"))
+    assert not (tmp_path / "outside").exists()
+    with pytest.raises(ValueError):
+        vc.path("../../outside", "evil")
+
+    # durability: a new controller over the same root re-registers volumes
+    vc.create(VolumeSpec(name="keep", size_mb=7))
+    vc2 = VolumeController(str(root))
+    assert vc2.get("keep").size_mb == 7
+    with pytest.raises(ValueError, match="already exists"):
+        vc2.create(VolumeSpec(name="keep"))
+    assert vc2.count() == 1
+
+
+def test_dashboard_job_post_rejects_non_job_kinds(cluster):
+    with DashboardServer(cluster) as dash:
+        req = urllib.request.Request(
+            dash.url + "/api/jobs",
+            data=json.dumps({
+                "kind": "PersistentVolumeClaim", "metadata": {"name": "x"},
+                "spec": {"resources": {"requests": {"storage": "1Gi"}}},
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400  # clean contract, not a 500
+
+
+def test_dashboard_volumes_crud(cluster, tmp_path):
+    from kubeflow_tpu.platform.volumes import VolumeController
+
+    vc = VolumeController(str(tmp_path / "vols"))
+    with DashboardServer(cluster, volumes=vc) as dash:
+        req = urllib.request.Request(
+            dash.url + "/api/volumes",
+            data=json.dumps({"name": "scratch", "size_mb": 64}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["name"] == "scratch"
+        rows = json.loads(
+            urllib.request.urlopen(dash.url + "/api/volumes").read()
+        )
+        assert rows[0]["name"] == "scratch" and rows[0]["size_mb"] == 64
+        summary = json.loads(
+            urllib.request.urlopen(dash.url + "/api/summary").read()
+        )
+        assert summary["volumes"] == 1
+        req = urllib.request.Request(
+            dash.url + "/api/volumes/scratch", method="DELETE"
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["deleted"] == "scratch"
+        assert json.loads(
+            urllib.request.urlopen(dash.url + "/api/volumes").read()
+        ) == []
