@@ -58,8 +58,10 @@ class TransformerConfig:
     #: sliding-window attention (requires causal; flash/reference impls):
     #: each position attends to the previous ``attn_window`` tokens only
     attn_window: int | None = None
-    attn_block_q: int = 128
-    attn_block_k: int = 128
+    #: None → per-shape selection (ops/flash_tuning.py: measured table
+    #: when a sweep has run on hardware, heuristic otherwise)
+    attn_block_q: int | None = None
+    attn_block_k: int | None = None
     interpret_kernels: bool = False  # Pallas interpret mode (CPU tests)
     remat: bool = False
     moe_every: int = 0               # every Nth layer uses MoE FFN (0 = never)

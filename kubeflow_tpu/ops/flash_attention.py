@@ -571,8 +571,8 @@ def flash_attention(
     scale: float | None = None,
     q_segment_ids: jax.Array | None = None,
     kv_segment_ids: jax.Array | None = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: int | None = DEFAULT_BLOCK_Q,
+    block_k: int | None = DEFAULT_BLOCK_K,
     interpret: bool = False,
     return_residuals: bool = False,
     window: int | None = None,
@@ -583,10 +583,18 @@ def flash_attention(
     query sees keys in [qpos - window + 1, qpos]; out-of-band tiles are
     skipped entirely, so compute is O(S·window) not O(S²).
 
+    ``block_q``/``block_k`` None → per-shape selection via
+    ``ops.flash_tuning.select_blocks`` (a measured table when one has
+    been swept on hardware, a heuristic otherwise).
+
     ``return_residuals`` additionally returns (lse,) — the per-row
     log-sum-exp — for cross-block merging (ring attention). Differentiable
     only in the default (no-residual) form.
     """
+    if block_q is None or block_k is None:
+        from kubeflow_tpu.ops.flash_tuning import resolve_blocks
+
+        block_q, block_k = resolve_blocks(q, k, block_q, block_k)
     if q.shape[1] != k.shape[1]:
         raise ValueError(
             f"q heads {q.shape[1]} != kv heads {k.shape[1]} "

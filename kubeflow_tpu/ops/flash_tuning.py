@@ -1,0 +1,187 @@
+"""Flash-attention block-size selection: measured table + sweep tool.
+
+The S512 regime measured ~23% MFU against 37% at S128 with the fixed
+128/128 blocks (VERDICT r04 weak-item 3): block shape is the one flash
+knob that moves long-sequence throughput, and the right value is a
+HARDWARE measurement, not a formula. This module closes the loop:
+
+- :func:`select_blocks` — (block_q, block_k) for a shape. Resolution:
+  a measured table (``ops/flash_blocks_v5e.json``, produced by the sweep
+  below, override path via ``KFT_FLASH_BLOCKS_FILE``) keyed by sequence
+  bucket, else a conservative heuristic (128×128 at short sequences —
+  the measured S128 sweet spot — widening block_k at S ≥ 256 to amortize
+  per-tile softmax overhead across fewer grid steps).
+- :func:`sweep_blocks` — ON-CHIP timing of candidate shapes with the
+  chained two-point method (bench.py discipline: ``block_until_ready``
+  is a no-op through the tunnel), writing the winners back to the table.
+
+``flash_attention(block_q=None)`` (and TransformerConfig
+``attn_block_q=None``) routes through :func:`select_blocks`, so a tuned
+table takes effect everywhere — training, serving, ring hops — without
+touching call sites.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_TABLE: dict | None = None
+_TABLE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "flash_blocks_v5e.json"
+)
+
+
+def _table() -> dict:
+    global _TABLE
+    if _TABLE is None:
+        path = os.environ.get("KFT_FLASH_BLOCKS_FILE", _TABLE_PATH)
+        try:
+            with open(path) as f:
+                _TABLE = json.load(f)
+        except (OSError, ValueError):
+            _TABLE = {}
+    return _TABLE
+
+
+def reset_table_cache() -> None:
+    global _TABLE
+    _TABLE = None
+
+
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    for c in range(min(n, cap), 0, -1):
+        if n % c == 0:
+            return c
+    return n
+
+
+def _fit(seq: int, cap: int) -> int:
+    """cap adapted to divide ``seq`` — but never DEGENERATE: a prime-ish
+    sequence length must hit the kernel's explicit 'pad inputs'
+    divisibility error, not silently run a block-1 grid."""
+    d = _largest_divisor_leq(seq, cap)
+    if d == seq or d >= 64:
+        return d
+    return cap
+
+
+def select_blocks(seq_q: int, seq_kv: int, head_dim: int) -> tuple[int, int]:
+    """(block_q, block_k) for a flash call. Table entries are keyed by
+    (seq bucket, head_dim) — a sweep at D=64 says nothing about the VMEM
+    footprint at D=256."""
+    entry = _table().get(f"{_seq_bucket(seq_kv)}:{head_dim}")
+    if entry:
+        bq, bk = int(entry[0]), int(entry[1])
+    elif seq_kv >= 256 and head_dim <= 128:
+        # heuristic until a sweep lands: wider K blocks amortize the
+        # per-tile online-softmax rescale over fewer grid steps; 128 rows
+        # of q keep the causal skip fine-grained. Large head_dim keeps
+        # 128x128 (tile bytes scale with D).
+        bq, bk = 128, 256
+    else:
+        bq, bk = 128, 128
+    return _fit(seq_q, bq), _fit(seq_kv, bk)
+
+
+def resolve_blocks(q, k, block_q, block_k) -> tuple[int, int]:
+    """None → selected; shared by flash_attention and the ring entry so
+    the resolution logic cannot drift between them."""
+    if block_q is None or block_k is None:
+        auto_q, auto_k = select_blocks(q.shape[2], k.shape[2], q.shape[3])
+        block_q = auto_q if block_q is None else block_q
+        block_k = auto_k if block_k is None else block_k
+    return block_q, block_k
+
+
+def _seq_bucket(s: int) -> int:
+    b = 128
+    while b < s:
+        b *= 2
+    return b
+
+
+def sweep_blocks(
+    *,
+    batch: int = 8,
+    heads: int = 12,
+    seq_lens: tuple[int, ...] = (128, 256, 512, 1024),
+    head_dim: int = 64,
+    candidates: tuple[tuple[int, int], ...] = (
+        (128, 128), (128, 256), (128, 512), (256, 128),
+        (256, 256), (256, 512), (512, 512),
+    ),
+    causal: bool = True,
+    reps: int = 3,
+    write: bool = True,
+    table_path: str | None = None,
+) -> dict:
+    """Time every candidate block shape per sequence length on the LIVE
+    backend; returns {seq: {"blocks": (bq, bk), "ms": best, "all": {...}}}
+    and (optionally) writes the winners to the measured table. Run this
+    on the chip — CPU-interpret timings are meaningless."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    results: dict = {}
+    for s in seq_lens:
+        per: dict[str, float] = {}
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        shape = (batch, heads, s, head_dim)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        for bq, bk in candidates:
+            if s % bq or s % bk or bq > s or bk > s:
+                continue
+
+            fn = jax.jit(
+                lambda q, k, v, _bq=bq, _bk=bk: flash_attention(
+                    q, k, v, causal=causal, block_q=_bq, block_k=_bk
+                )
+            )
+            out = fn(q, k, v)  # compile
+            np.asarray(out[0, 0, 0])  # host-transfer sync
+
+            def run(n):
+                t0 = time.perf_counter()
+                o = None
+                for _ in range(n):
+                    o = fn(q, k, v)
+                np.asarray(o[0, 0, 0])
+                return time.perf_counter() - t0
+
+            # chained two-point: the constant tunnel RTT cancels
+            est = []
+            for _ in range(reps):
+                t_small, t_large = run(5), run(20)
+                est.append((t_large - t_small) / 15)
+            med = sorted(est)[len(est) // 2]
+            if med <= 0:
+                # timing noise exceeded the compute delta (fast shape,
+                # jittery tunnel) — an invalid sample must never be
+                # crowned the winner
+                continue
+            per[f"{bq}x{bk}"] = round(med * 1e3, 4)
+        if not per:
+            continue
+        best = min(per, key=per.get)
+        bq, bk = (int(x) for x in best.split("x"))
+        results[s] = {"blocks": (bq, bk), "ms": per[best], "all": per}
+    if write and results:
+        path = table_path or os.environ.get(
+            "KFT_FLASH_BLOCKS_FILE", _TABLE_PATH
+        )
+        table = dict(_table())
+        for s, r in results.items():
+            table[f"{_seq_bucket(s)}:{head_dim}"] = list(r["blocks"])
+        with open(path, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        reset_table_cache()
+    return results

@@ -227,17 +227,22 @@ def ring_attention_local(
     causal: bool = False,
     scale: float | None = None,
     segment_ids=None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = 128,
+    block_k: int | None = 128,
     interpret: bool = False,
 ):
     """Ring attention on LOCAL seq shards — call inside shard_map where
     ``axis_name`` is a mesh axis and q/k/v are (B, H, S_local, D).
     ``segment_ids`` (B, S_local): packed-sequence block-diagonal masking —
     the local labels mask q, and a rotating copy rides the ring with each
-    kv shard."""
+    kv shard. ``block_q``/``block_k`` None → per-LOCAL-shape selection
+    (ops/flash_tuning.py), resolved here once so fwd and bwd hops agree."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if block_q is None or block_k is None:
+        from kubeflow_tpu.ops.flash_tuning import resolve_blocks
+
+        block_q, block_k = resolve_blocks(q, k, block_q, block_k)
     return _ring_local(
         q, k, v, segment_ids, segment_ids, axis_name, causal, scale,
         (block_q, block_k), interpret

@@ -25,13 +25,15 @@ def ulysses_attention_local(
     causal: bool = False,
     scale: float | None = None,
     segment_ids=None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = 128,
+    block_k: int | None = 128,
     interpret: bool = False,
 ):
     """Inside shard_map: q/k/v are (B, H, S_local, D); H must divide the
     axis size. ``segment_ids`` (B, S_local) gives packed-sequence
-    block-diagonal masking. Returns (B, H, S_local, D)."""
+    block-diagonal masking. Returns (B, H, S_local, D). None block sizes
+    resolve per the FULL-sequence shapes the inner kernel sees (after the
+    all_to_all the local view is full-seq, head-sharded)."""
     seg_kw = {}
     if segment_ids is not None:
         # after the all_to_all each rank attends over the FULL sequence, so

@@ -315,3 +315,88 @@ def test_flash_window_requires_causal(qkv):
     q, k, v = qkv
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, k, v, causal=False, window=8, interpret=True)
+
+
+# --------------------------------------------------------- block selection
+
+
+@pytest.mark.parametrize("blocks", [(128, 256), (256, 128), (256, 256),
+                                    (128, 512), (512, 512)])
+def test_flash_nondefault_blocks_match_reference(blocks):
+    """Every candidate block shape the S512 tuner sweeps must be
+    numerically identical to reference — fwd AND grad — so the sweep can
+    pick purely on speed (interpret mode exercises the same tile code)."""
+    bq, bk = blocks
+    B, H, S, D = 1, 2, 512, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.float32) for kk in ks)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=bq, block_k=bk, interpret=True
+    )
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+    def f_loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    g_flash = jax.grad(
+        f_loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk, interpret=True
+        ))
+    )(q, k, v)
+    g_ref = jax.grad(
+        f_loss(lambda q, k, v: reference_attention(q, k, v, causal=True))
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(g_flash), np.asarray(g_ref), rtol=5e-3, atol=5e-3
+    )
+
+
+def test_block_selection_table_and_heuristic(tmp_path, monkeypatch):
+    from kubeflow_tpu.ops import flash_tuning as ft
+
+    # no table: heuristic — 128x128 short, wider K at 256+
+    monkeypatch.setenv("KFT_FLASH_BLOCKS_FILE", str(tmp_path / "none.json"))
+    ft.reset_table_cache()
+    assert ft.select_blocks(128, 128, 64) == (128, 128)
+    assert ft.select_blocks(512, 512, 64) == (128, 256)
+    # big head_dim stays conservative (tile bytes scale with D)
+    assert ft.select_blocks(512, 512, 256) == (128, 128)
+    # block sizes divide the sequence when a sane divisor exists
+    assert ft.select_blocks(96, 96, 64) == (96, 96)
+    assert ft.select_blocks(384, 384, 64) == (128, 192)
+    # prime-ish lengths must NOT degrade to block-1 grids — selection
+    # keeps a non-dividing cap so the kernel's explicit 'pad inputs'
+    # divisibility error fires instead
+    bq, bk = ft.select_blocks(509, 509, 64)
+    assert bq > 1 and bk > 1 and (509 % bq and 509 % bk)
+    q = jnp.zeros((1, 1, 509, 64), jnp.float32)
+    with pytest.raises(ValueError, match="pad inputs"):
+        flash_attention(q, q, q, causal=True, block_q=None, block_k=None,
+                        interpret=True)
+
+    # a measured table wins (keyed by seq bucket AND head_dim)
+    (tmp_path / "t.json").write_text('{"512:64": [256, 512]}')
+    monkeypatch.setenv("KFT_FLASH_BLOCKS_FILE", str(tmp_path / "t.json"))
+    ft.reset_table_cache()
+    assert ft.select_blocks(512, 512, 64) == (256, 512)
+    assert ft.select_blocks(512, 512, 128) == (128, 256)  # other D: heuristic
+    # the table's bucket entry still adapts to non-dividing shapes
+    assert ft.select_blocks(384, 384, 64) == (192, 384)
+    ft.reset_table_cache()
+
+
+def test_flash_auto_blocks_parity():
+    """block_q=None routes through select_blocks and stays exact."""
+    B, H, S, D = 1, 2, 256, 32
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.float32) for kk in ks)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=None, block_k=None, interpret=True
+    )
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )

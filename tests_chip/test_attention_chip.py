@@ -105,3 +105,49 @@ def test_generation_scan_on_chip():
     toks = np.asarray(toks)
     assert toks.shape == (2, 16)
     assert (np.asarray(n_valid) <= 16).all()
+
+
+def test_block_sweep_and_tuned_s512_parity(tmp_path):
+    """Sweep candidate flash block shapes ON CHIP (compiled Mosaic, the
+    thing interpret mode cannot exercise), persist the winners, and pin
+    the tuned S512 configuration to reference numerics."""
+    import numpy as np
+
+    from kubeflow_tpu.ops import flash_tuning as ft
+    from kubeflow_tpu.ops.flash_attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    res = ft.sweep_blocks(
+        batch=4, heads=8, seq_lens=(512,), head_dim=64, reps=2,
+        table_path=str(tmp_path / "blocks.json"),
+    )
+    assert 512 in res and res[512]["blocks"], res
+    # every candidate timed; winner is the argmin
+    best = res[512]["blocks"]
+    assert f"{best[0]}x{best[1]}" in res[512]["all"]
+
+    import os
+
+    os.environ["KFT_FLASH_BLOCKS_FILE"] = str(tmp_path / "blocks.json")
+    ft.reset_table_cache()
+    try:
+        assert ft.select_blocks(512, 512, 64) == tuple(best)
+        import jax
+        import jax.numpy as jnp
+
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (
+            jax.random.normal(kk, (2, 4, 512, 64), jnp.bfloat16) for kk in ks
+        )
+        out = flash_attention(q, k, v, causal=True, block_q=None,
+                              block_k=None)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2,  # bf16 operands
+        )
+    finally:
+        os.environ.pop("KFT_FLASH_BLOCKS_FILE", None)
+        ft.reset_table_cache()
