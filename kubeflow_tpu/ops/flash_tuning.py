@@ -178,7 +178,14 @@ def sweep_blocks(
         path = table_path or os.environ.get(
             "KFT_FLASH_BLOCKS_FILE", _TABLE_PATH
         )
-        table = dict(_table())
+        # merge into the file BEING WRITTEN (not whatever _table() cached
+        # from the env/default path): successive sweeps at different
+        # head_dims into one explicit table_path must accumulate
+        try:
+            with open(path) as f:
+                table = json.load(f)
+        except (OSError, ValueError):
+            table = {}
         for s, r in results.items():
             table[f"{_seq_bucket(s)}:{head_dim}"] = list(r["blocks"])
         with open(path, "w") as f:

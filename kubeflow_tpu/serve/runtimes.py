@@ -246,12 +246,21 @@ def default_registry() -> RuntimeRegistry:
         )
     )
     from kubeflow_tpu.serve.lightgbm_runtime import LightGBMRuntimeModel
+    from kubeflow_tpu.serve.pmml_runtime import PMMLRuntimeModel
 
     reg.register(
         ServingRuntime(
             name="kubeflow-tpu-lightgbm",
             supported_formats=("lightgbm",),
             factory=LightGBMRuntimeModel,
+            priority=1,
+        )
+    )
+    reg.register(
+        ServingRuntime(
+            name="kubeflow-tpu-pmml",
+            supported_formats=("pmml",),
+            factory=PMMLRuntimeModel,
             priority=1,
         )
     )

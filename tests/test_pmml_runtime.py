@@ -1,0 +1,305 @@
+"""PMML runtime (serve/pmml_runtime.py): RegressionModel on the MXU
+matmul path, TreeModel/MiningModel forests on the shared GBDT walk —
+checked against hand-computed expectations and an independent tree
+evaluator over the XML (SURVEY.md §2.2 "Other runtimes" pmml row)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serve.pmml_runtime import PMMLRuntimeModel, parse_pmml
+
+HEADER = """<?xml version="1.0"?>
+<PMML xmlns="http://www.dmg.org/PMML-4_4" version="4.4">
+ <DataDictionary>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  <DataField name="x0" optype="continuous" dataType="double"/>
+  <DataField name="x1" optype="continuous" dataType="double"/>
+ </DataDictionary>
+"""
+
+REGRESSION = HEADER + """
+ <RegressionModel functionName="regression">
+  <MiningSchema>
+   <MiningField name="y" usageType="target"/>
+   <MiningField name="x0"/><MiningField name="x1"/>
+  </MiningSchema>
+  <RegressionTable intercept="1.5">
+   <NumericPredictor name="x0" coefficient="2.0"/>
+   <NumericPredictor name="x1" coefficient="-0.5"/>
+  </RegressionTable>
+ </RegressionModel>
+</PMML>
+"""
+
+LOGISTIC = HEADER + """
+ <RegressionModel functionName="classification" normalizationMethod="logit">
+  <MiningSchema>
+   <MiningField name="y" usageType="target"/>
+   <MiningField name="x0"/><MiningField name="x1"/>
+  </MiningSchema>
+  <RegressionTable intercept="0.0" targetCategory="1">
+   <NumericPredictor name="x0" coefficient="3.0"/>
+   <NumericPredictor name="x1" coefficient="1.0"/>
+  </RegressionTable>
+ </RegressionModel>
+</PMML>
+"""
+
+TREE = HEADER + """
+ <TreeModel functionName="regression">
+  <MiningSchema>
+   <MiningField name="y" usageType="target"/>
+   <MiningField name="x0"/><MiningField name="x1"/>
+  </MiningSchema>
+  <Node>
+   <True/>
+   <Node score="-1.0">
+    <SimplePredicate field="x0" operator="lessOrEqual" value="0.5"/>
+    <Node score="10.0">
+     <SimplePredicate field="x1" operator="lessThan" value="-1.0"/>
+    </Node>
+    <Node score="20.0">
+     <SimplePredicate field="x1" operator="greaterOrEqual" value="-1.0"/>
+    </Node>
+   </Node>
+   <Node score="30.0">
+    <SimplePredicate field="x0" operator="greaterThan" value="0.5"/>
+   </Node>
+  </Node>
+ </TreeModel>
+</PMML>
+"""
+
+FOREST = HEADER + """
+ <MiningModel functionName="regression">
+  <MiningSchema>
+   <MiningField name="y" usageType="target"/>
+   <MiningField name="x0"/><MiningField name="x1"/>
+  </MiningSchema>
+  <Segmentation multipleModelMethod="average">
+   <Segment><True/>
+    <TreeModel functionName="regression">
+     <Node><True/>
+      <Node score="2.0">
+       <SimplePredicate field="x0" operator="lessOrEqual" value="0.0"/>
+      </Node>
+      <Node score="4.0">
+       <SimplePredicate field="x0" operator="greaterThan" value="0.0"/>
+      </Node>
+     </Node>
+    </TreeModel>
+   </Segment>
+   <Segment><True/>
+    <TreeModel functionName="regression">
+     <Node><True/>
+      <Node score="10.0">
+       <SimplePredicate field="x1" operator="lessOrEqual" value="1.0"/>
+      </Node>
+      <Node score="20.0">
+       <SimplePredicate field="x1" operator="greaterThan" value="1.0"/>
+      </Node>
+     </Node>
+    </TreeModel>
+   </Segment>
+  </Segmentation>
+ </MiningModel>
+</PMML>
+"""
+
+
+def _runtime(tmp_path, doc, name="m"):
+    p = tmp_path / f"{name}.pmml"
+    p.write_text(doc)
+    m = PMMLRuntimeModel(name, str(p))
+    m.load()
+    return m
+
+
+def test_regression_model_matmul(tmp_path):
+    m = _runtime(tmp_path, REGRESSION)
+    x = np.asarray([[1.0, 2.0], [0.0, 4.0]], np.float32)
+    out = m.predict(m.preprocess({"instances": x.tolist()}))
+    # 1.5 + 2*x0 - 0.5*x1, by hand
+    np.testing.assert_allclose(out, [2.5, -0.5], rtol=1e-6)
+
+
+def test_logistic_link(tmp_path):
+    m = _runtime(tmp_path, LOGISTIC)
+    x = np.asarray([[0.0, 0.0], [1.0, 1.0]], np.float32)
+    out = m.predict(x)
+    want = 1 / (1 + np.exp(-(3 * x[:, 0] + x[:, 1])))
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_tree_model_walk(tmp_path):
+    m = _runtime(tmp_path, TREE)
+    cases = [
+        ([0.5, -2.0], 10.0),   # x0<=0.5 (boundary!), x1<-1
+        ([0.5, -1.0], 20.0),   # x1 exactly -1: NOT < -1
+        ([0.6, 0.0], 30.0),    # x0>0.5
+        ([0.0, 5.0], 20.0),
+    ]
+    out = m.predict(np.asarray([c[0] for c in cases], np.float32))
+    np.testing.assert_allclose(out, [c[1] for c in cases], rtol=1e-6)
+
+
+def test_forest_average(tmp_path):
+    m = _runtime(tmp_path, FOREST)
+    x = np.asarray([[-1.0, 0.0], [1.0, 2.0]], np.float32)
+    out = m.predict(x)
+    # average of (2|4) and (10|20): [-1,0] → (2+10)/2; [1,2] → (4+20)/2
+    np.testing.assert_allclose(out, [6.0, 12.0], rtol=1e-6)
+
+
+def test_weighted_average_is_a_mean(tmp_path):
+    """weightedAverage divides by the weight sum (PMML semantics) — a
+    weighted SUM would scale predictions by sum(weights)."""
+    doc = FOREST.replace(
+        'multipleModelMethod="average"', 'multipleModelMethod="weightedAverage"'
+    ).replace("<Segment><True/>", '<Segment weight="2.0"><True/>')
+    m = _runtime(tmp_path, doc, "wavg")
+    out = m.predict(np.asarray([[-1.0, 0.0]], np.float32))
+    # both weights 2.0: (2*2 + 2*10)/(2+2) = 6.0, same as plain average
+    np.testing.assert_allclose(out, [6.0], rtol=1e-6)
+
+
+def test_first_match_order_fails_closed(tmp_path):
+    """PMML evaluates children in document order; shapes this walker
+    cannot represent must be parse errors, never silent misroutes."""
+    # first child <True/> would always win in PMML — reject
+    true_first = TREE.replace(
+        """<Node score="-1.0">
+    <SimplePredicate field="x0" operator="lessOrEqual" value="0.5"/>""",
+        """<Node score="-1.0">
+    <True/>""",
+    )
+    (tmp_path / "tf.pmml").write_text(true_first)
+    with pytest.raises(RuntimeError, match="first child"):
+        parse_pmml(str(tmp_path / "tf.pmml"))
+    # non-complementary second predicate (different field) — reject
+    noncomp = TREE.replace(
+        '<SimplePredicate field="x0" operator="greaterThan" value="0.5"/>',
+        '<SimplePredicate field="x1" operator="greaterThan" value="0.5"/>',
+    )
+    (tmp_path / "nc.pmml").write_text(noncomp)
+    with pytest.raises(RuntimeError, match="not the\n?.*complement|complement"):
+        parse_pmml(str(tmp_path / "nc.pmml"))
+
+
+def test_fail_closed_and_registry(tmp_path):
+    compound = TREE.replace(
+        '<SimplePredicate field="x0" operator="lessOrEqual" value="0.5"/>',
+        '<CompoundPredicate booleanOperator="and">'
+        '<SimplePredicate field="x0" operator="lessOrEqual" value="0.5"/>'
+        "</CompoundPredicate>",
+    )
+    p = tmp_path / "c.pmml"
+    p.write_text(compound)
+    with pytest.raises(RuntimeError, match="SimplePredicate or True"):
+        parse_pmml(str(p))
+
+    (tmp_path / "bad.pmml").write_text("<NotPMML/>")
+    with pytest.raises(RuntimeError, match="not <PMML>"):
+        parse_pmml(str(tmp_path / "bad.pmml"))
+
+    (tmp_path / "n.pmml").write_text(
+        HEADER + "<NeuralNetwork/></PMML>"
+    )
+    with pytest.raises(RuntimeError, match="no supported model element"):
+        parse_pmml(str(tmp_path / "n.pmml"))
+
+    # registry resolution + feature-count contract
+    from kubeflow_tpu.serve.runtimes import default_registry
+    from kubeflow_tpu.serve.spec import PredictorSpec
+
+    rt = default_registry().resolve(
+        PredictorSpec(model_format="pmml", storage_uri="file:///x")
+    )
+    assert rt.name == "kubeflow-tpu-pmml"
+    m = _runtime(tmp_path, REGRESSION, "reg")
+    with pytest.raises(ValueError, match="expects 2 features"):
+        m.preprocess({"instances": [[1.0, 2.0, 3.0]]})
+
+
+def test_fuzz_forest_against_independent_walker(tmp_path):
+    """Random forests serialized to PMML, device walk vs a direct XML
+    evaluator that implements PMML predicate semantics from scratch."""
+    import xml.etree.ElementTree as ET
+
+    rng = np.random.default_rng(0)
+    n_feat = 3
+
+    def rand_node(depth):
+        if depth == 0 or rng.random() < 0.3:
+            return f'<Node score="{round(float(rng.normal()), 3)}">%PRED%</Node>'
+        f = int(rng.integers(0, n_feat))
+        t = round(float(rng.normal()), 3)
+        op_l, op_r = (
+            ("lessOrEqual", "greaterThan")
+            if rng.random() < 0.5 else ("lessThan", "greaterOrEqual")
+        )
+        left = rand_node(depth - 1).replace(
+            "%PRED%",
+            f'<SimplePredicate field="x{f}" operator="{op_l}" value="{t}"/>',
+        )
+        right = rand_node(depth - 1).replace(
+            "%PRED%",
+            f'<SimplePredicate field="x{f}" operator="{op_r}" value="{t}"/>',
+        )
+        return f"<Node>%PRED%{left}{right}</Node>"
+
+    def eval_node(el, x):
+        kids = [c for c in el if c.tag.endswith("Node")]
+        if not kids:
+            return float(el.get("score"))
+        for kid in kids:
+            sp = next((c for c in kid if c.tag.endswith("SimplePredicate")), None)
+            v = x[int(sp.get("field")[1:])]
+            t = float(sp.get("value"))
+            ok = {
+                "lessOrEqual": v <= t, "lessThan": v < t,
+                "greaterThan": v > t, "greaterOrEqual": v >= t,
+            }[sp.get("operator")]
+            if ok:
+                return eval_node(kid, x)
+        raise AssertionError("no branch matched")
+
+    trees = [
+        rand_node(3).replace("%PRED%", "<True/>") for _ in range(5)
+    ]
+    header = (
+        '<?xml version="1.0"?><PMML version="4.4"><DataDictionary>'
+        + "".join(
+            f'<DataField name="x{i}" optype="continuous"/>'
+            for i in range(n_feat)
+        )
+        + "</DataDictionary>"
+    )
+    doc = (
+        header
+        + '<MiningModel functionName="regression">'
+        + '<Segmentation multipleModelMethod="sum">'
+        + "".join(
+            f"<Segment><True/>"
+            f'<TreeModel functionName="regression">{t}</TreeModel>'
+            f"</Segment>"
+            for t in trees
+        )
+        + "</Segmentation></MiningModel></PMML>"
+    )
+    p = tmp_path / "f.pmml"
+    p.write_text(doc)
+    m = PMMLRuntimeModel("f", str(p))
+    m.load()
+    x = rng.normal(size=(64, n_feat)).astype(np.float32)
+    got = m.predict(x)
+    roots = [
+        next(c for c in ET.fromstring(f"<w>{t}</w>") if c.tag == "Node")
+        for t in trees
+    ]
+    want = [
+        sum(eval_node(r, row) for r in roots) for row in x
+    ]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
