@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""One-command chip session: everything round 5 needs from TPU time.
+
+The tunnel wedges unpredictably (three times across rounds 4-5), so chip
+minutes are precious. This runbook captures, in priority order, exactly
+what VERDICT r04 asked for, each stage isolated in a SUBPROCESS with a
+timeout so a mid-stage wedge can never take down the stages after it or
+hang the caller:
+
+  1. tests_chip/ (bf16 flash S512 fwd+bwd parity, engine-on-chip incl.
+     prefix reuse, block sweep + tuned parity)    [VERDICT item 2 gate]
+  2. flash block sweep at BERT + LM head dims, winners persisted to
+     ops/flash_blocks_v5e.json (committed → every later run is tuned)
+  3. python bench.py — full driver-format suite   [VERDICT item 1]
+  4. BERT MFU batch/seq sweep (B32/64 × S128/512) [items 2+3 evidence]
+
+Usage:  python scripts/chip_session.py [--skip-tests] [--out DIR]
+Writes: <out>/chip_session_report.json + stage logs. Safe to re-run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_stage(name, cmd, *, timeout, out_dir, env=None):
+    log = os.path.join(out_dir, f"{name}.log")
+    t0 = time.time()
+    try:
+        with open(log, "w") as f:
+            proc = subprocess.run(
+                cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
+                timeout=timeout, env=env or os.environ.copy(),
+            )
+        status = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
+    except subprocess.TimeoutExpired:
+        status = "timeout"
+    wall = round(time.time() - t0, 1)
+    print(f"[{name}] {status} ({wall}s) → {log}", flush=True)
+    return {"status": status, "wall_s": wall, "log": log}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-tests", action="store_true")
+    ap.add_argument("--out", default=os.path.join(REPO, "chip_out"))
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    sys.path.insert(0, REPO)
+    from kubeflow_tpu.core.deviceprobe import probe_backend
+
+    backend = probe_backend(timeout_s=150)
+    print(f"backend: {backend}", flush=True)
+    report = {"backend": backend, "started": time.time(), "stages": {}}
+    if backend in ("unreachable", "cpu"):
+        report["aborted"] = f"no TPU ({backend})"
+        with open(os.path.join(args.out, "chip_session_report.json"), "w") as f:
+            json.dump(report, f, indent=1)
+        return 1
+
+    if not args.skip_tests:
+        report["stages"]["tests_chip"] = run_stage(
+            "tests_chip",
+            [sys.executable, "-m", "pytest", "tests_chip", "-q"],
+            timeout=2400, out_dir=args.out,
+        )
+
+    sweep_prog = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from kubeflow_tpu.ops.flash_tuning import sweep_blocks\n"
+        "import json\n"
+        "r64 = sweep_blocks(seq_lens=(128, 256, 512, 1024), head_dim=64)\n"
+        "r128 = sweep_blocks(seq_lens=(256, 512), head_dim=128,\n"
+        "                    candidates=((128,128),(128,256),(256,256)))\n"
+        "print(json.dumps({'d64': {k: v for k, v in r64.items()},\n"
+        "                  'd128': {k: v for k, v in r128.items()}},\n"
+        "                 default=str))\n"
+    ) % REPO
+    report["stages"]["block_sweep"] = run_stage(
+        "block_sweep", [sys.executable, "-c", sweep_prog],
+        timeout=1800, out_dir=args.out,
+    )
+
+    report["stages"]["bench"] = run_stage(
+        "bench", [sys.executable, "bench.py"], timeout=3600, out_dir=args.out,
+    )
+
+    mfu_prog = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import json, bench\n"
+        "out = {}\n"
+        "for B, S in ((32, 128), (64, 128), (32, 512), (64, 512)):\n"
+        "    bench.BERT_BATCH, bench.BERT_SEQ = B, S\n"
+        "    r = bench.bench_bert()\n"
+        "    out[f'B{B}/S{S}'] = {'ms': r['value'],\n"
+        "        'mfu': r['detail'].get('mfu_pct_vs_v5e_peak')}\n"
+        "    print(f'B{B}/S{S}:', out[f'B{B}/S{S}'], flush=True)\n"
+        "print('SWEEP', json.dumps(out))\n"
+    ) % REPO
+    report["stages"]["mfu_sweep"] = run_stage(
+        "mfu_sweep", [sys.executable, "-c", mfu_prog],
+        timeout=3600, out_dir=args.out,
+    )
+
+    report["finished"] = time.time()
+    with open(os.path.join(args.out, "chip_session_report.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print("report:", os.path.join(args.out, "chip_session_report.json"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
