@@ -64,6 +64,13 @@ class TransformerConfig:
     attn_block_k: int | None = None
     interpret_kernels: bool = False  # Pallas interpret mode (CPU tests)
     remat: bool = False
+    #: rematerialization policy when remat=True (the HBM-vs-FLOPs MFU
+    #: lever): None = full remat (recompute everything — max memory
+    #: saving, most recompute); "dots" = save matmul outputs, recompute
+    #: only the cheap elementwise/softmax work (jax
+    #: dots_with_no_batch_dims_saveable — usually the throughput sweet
+    #: spot on TPU: MXU results are kept, VPU work is replayed).
+    remat_policy: str | None = None
     moe_every: int = 0               # every Nth layer uses MoE FFN (0 = never)
     moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
     dropout_rate: float = 0.0
@@ -104,6 +111,10 @@ class TransformerConfig:
                     f"(got {self.attn_impl!r}); window + context parallelism "
                     "is not implemented"
                 )
+        if self.remat_policy not in (None, "dots"):
+            raise ValueError(
+                f"remat_policy {self.remat_policy!r} not in (None, 'dots')"
+            )
         if self.n_kv_heads is not None and self.n_kv_heads < 1:
             raise ValueError(f"n_kv_heads must be >= 1, got {self.n_kv_heads}")
         if self.n_heads % self.kv_heads:
@@ -562,7 +573,15 @@ class TransformerLM(nn.Module):
             x = x + jnp.take(pos_emb, positions, axis=0).astype(cfg.dtype)
         x = _act_constraint(x)
 
-        BlockCls = nn.remat(Block) if cfg.remat else Block
+        if cfg.remat and cfg.remat_policy == "dots":
+            BlockCls = nn.remat(
+                Block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat:
+            BlockCls = nn.remat(Block)
+        else:
+            BlockCls = Block
         new_cache = {} if cache is not None else None
         for i in range(cfg.n_layers):
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0

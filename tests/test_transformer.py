@@ -297,3 +297,43 @@ def test_sliding_window_config_validation():
         TransformerConfig(causal=False, attn_window=8).validate()
     with _pytest.raises(ValueError, match="context parallelism"):
         TransformerConfig(attn_impl="ring", attn_window=8).validate()
+
+
+def test_remat_policies_preserve_loss_and_grads(devices8):
+    """remat and remat_policy='dots' trade memory for recompute — they
+    must change NOTHING numerically (same loss, same grads)."""
+    import optax
+
+    def make(remat, policy):
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            attn_impl="reference", dtype=jnp.float32,
+            remat=remat, remat_policy=policy,
+        )
+        return TransformerLM(cfg)
+
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 64)
+    tgts = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    base = make(False, None)
+    params = base.init(jax.random.PRNGKey(2), toks)["params"]
+
+    def loss_fn(model):
+        def f(p):
+            lg = model.apply({"params": p}, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                lg, tgts
+            ).mean()
+        return f
+
+    l0, g0 = jax.value_and_grad(loss_fn(base))(params)
+    for remat, policy in ((True, None), (True, "dots")):
+        l1, g1 = jax.value_and_grad(loss_fn(make(remat, policy)))(params)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            g0, g1,
+        )
+    with pytest.raises(ValueError, match="remat_policy"):
+        TransformerConfig(remat=True, remat_policy="bogus").validate()
