@@ -115,6 +115,9 @@ class TransformerConfig:
             raise ValueError(
                 f"remat_policy {self.remat_policy!r} not in (None, 'dots')"
             )
+        if self.remat_policy is not None and not self.remat:
+            # an inert policy field would read as "remat enabled"
+            raise ValueError("remat_policy requires remat=True")
         if self.n_kv_heads is not None and self.n_kv_heads < 1:
             raise ValueError(f"n_kv_heads must be >= 1, got {self.n_kv_heads}")
         if self.n_heads % self.kv_heads:
@@ -573,15 +576,11 @@ class TransformerLM(nn.Module):
             x = x + jnp.take(pos_emb, positions, axis=0).astype(cfg.dtype)
         x = _act_constraint(x)
 
-        if cfg.remat and cfg.remat_policy == "dots":
-            BlockCls = nn.remat(
-                Block,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            )
-        elif cfg.remat:
-            BlockCls = nn.remat(Block)
-        else:
-            BlockCls = Block
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if cfg.remat_policy == "dots" else None
+        )
+        BlockCls = nn.remat(Block, policy=policy) if cfg.remat else Block
         new_cache = {} if cache is not None else None
         for i in range(cfg.n_layers):
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0

@@ -337,3 +337,5 @@ def test_remat_policies_preserve_loss_and_grads(devices8):
         )
     with pytest.raises(ValueError, match="remat_policy"):
         TransformerConfig(remat=True, remat_policy="bogus").validate()
+    with pytest.raises(ValueError, match="requires remat=True"):
+        TransformerConfig(remat=False, remat_policy="dots").validate()
