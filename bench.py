@@ -18,9 +18,10 @@ Configs (BASELINE.json `configs[0..4]` / SURVEY.md §6 rows 1-5):
   2. resnet50_train_throughput   — ResNet-50 CIFAR-10 DP step
   3. bert_base_train_step_time   — BERT-base MLM step with **MFU** from
                                    analytic FLOPs vs v5e bf16 peak
-  4. katib_time_to_goal          — 16 parallel gang-scheduled trials on a
+  4. katib_trials_to_goal        — 16 parallel gang-scheduled trials on a
                                    simulated 4-slice fleet, bayesian vs
-                                   random time/trials-to-goal
+                                   random TRIALS-to-goal (wall time is
+                                   host-noise; trials are the chip cost)
   5. kserve_bert_p50_latency     — p50/p99 + cold-start through the real
                                    ModelServer over REST and gRPC
 """
@@ -569,12 +570,16 @@ def bench_katib() -> dict:
     rand = run("random", seed=1, max_trials=512)
     both_met = bayes["goal_met"] and rand["goal_met"]
     return {
-        "metric": "katib_time_to_goal",
-        "value": round(bayes["seconds"], 2),
-        "unit": "s",
-        # trials are the real cost on TPU fleets (each is minutes of chip
-        # time; the 0.1s subprocess spawn here is not the economics), so the
-        # efficiency ratio is trials-to-goal, not spawn-bound wall time
+        # trials-to-goal IS the headline: each trial is minutes of chip
+        # time on a real fleet, while the wall seconds here are dominated
+        # by subprocess spawn on whatever host the driver runs (VERDICT
+        # r04 weak-item 7: the wall number varied 6x between identical
+        # runs on different hosts; the trial count did not)
+        "metric": "katib_trials_to_goal",
+        # the name asserts the goal was REACHED — an exhausted budget
+        # must read as null, not as the budget number
+        "value": bayes["launched"] if bayes["goal_met"] else None,
+        "unit": "trials",
         "vs_baseline": (
             round(rand["launched"] / bayes["launched"], 3) if both_met else None
         ),
