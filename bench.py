@@ -959,7 +959,7 @@ def bench_engine() -> dict:
         pe.submit(requests[0][:16], max_new_tokens=8)
         t_paged, paged_tokens, _ = run_fanout(pe)
         paged_concurrent = pe.stats["max_concurrent"]
-        pages_peak = pe.stats.get("pages_used_peak", 0)
+        pages_peak = pe.stats.get("kv_pages_used_peak", 0)
     finally:
         pe.stop()
     paged_tok_per_s = paged_tokens / t_paged if t_paged > 0 else float("nan")
@@ -996,7 +996,7 @@ def bench_engine() -> dict:
                 "hbm_density_x": round(dense_rectangle / pool_tokens, 2),
                 "dense_cache_tokens": dense_rectangle,
                 "pool_tokens": pool_tokens,
-                "pages_used_peak": pages_peak,
+                "kv_pages_used_peak": pages_peak,
                 "page_size": 64,
                 "max_concurrent": paged_concurrent,
                 "all_resident": paged_concurrent == 16,

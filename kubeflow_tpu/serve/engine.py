@@ -232,6 +232,10 @@ class LMEngine:
             "max_concurrent": 0, "prefix_hits": 0, "prefix_tokens_reused": 0,
             "prefill_pieces": 0,
         }
+        if self.paged:
+            # pre-initialized: /metrics iterates this dict from another
+            # thread; a first-admission key INSERT would race it
+            self.stats["kv_pages_used_peak"] = 0
 
         # prefix cache (vLLM automatic-prefix-caching analog): completed
         # prompt prefills donate their KV, keyed by the prompt ids rounded
@@ -834,8 +838,8 @@ class LMEngine:
             self.stats["max_concurrent"], sum(s is not None for s in self._slots)
         )
         if self.paged:
-            self.stats["pages_used_peak"] = max(
-                self.stats.get("pages_used_peak", 0), self.pager.used_pages
+            self.stats["kv_pages_used_peak"] = max(
+                self.stats["kv_pages_used_peak"], self.pager.used_pages
             )
         self._prefilling[row] = {
             "req": req, "rest": rest, "base": base, "C": C,

@@ -429,7 +429,7 @@ class ModelServer:
             eng = getattr(model, "engine", None)
             if eng is None or not hasattr(eng, "stats"):
                 continue
-            for key, val in eng.stats.items():
+            for key, val in dict(eng.stats).items():  # snapshot: engine thread writes
                 lines.append(
                     f'kubeflow_tpu_engine_{key}{{model="{name}"}} {val}'
                 )
@@ -437,6 +437,13 @@ class ModelServer:
                 f'kubeflow_tpu_engine_active_rows{{model="{name}"}} '
                 f"{int(eng.active.sum())}"
             )
+            pager = getattr(eng, "pager", None)
+            if pager is not None:  # paged-KV engines: live pool pressure
+                for key, val in pager.stats().items():
+                    lines.append(
+                        f'kubeflow_tpu_engine_kv_{key}{{model="{name}"}} '
+                        f"{val}"
+                    )
         return web.Response(text="\n".join(lines) + "\n")
 
     # -- runtime ------------------------------------------------------------

@@ -239,7 +239,7 @@ def test_page_backpressure_queues_and_completes(model_and_params):
             assert results[i] == ref.submit(ids, max_new_tokens=12), i
         # the pool bound really bit: peak pages within budget, and fewer
         # rows ran concurrently than max_batch allows
-        assert eng.stats["pages_used_peak"] <= 8
+        assert eng.stats["kv_pages_used_peak"] <= 8
         assert eng.stats["max_concurrent"] <= 4
     finally:
         eng.stop()
@@ -284,7 +284,7 @@ def test_paged_density_vs_dense_rectangle(model_and_params):
         # ALL 8 mixed-length rows were resident simultaneously in a pool
         # 4x smaller than their dense rectangle
         assert eng.stats["max_concurrent"] == 8
-        assert eng.stats["pages_used_peak"] * 64 <= pool_tokens
+        assert eng.stats["kv_pages_used_peak"] * 64 <= pool_tokens
     finally:
         eng.stop()
 
@@ -348,3 +348,40 @@ def test_tp_paged_engine_matches_unsharded():
     finally:
         plain.stop()
         sharded.stop()
+
+
+def test_paged_engine_exports_pool_gauges():
+    """/metrics on an engine-backed server shows the paged pool's live
+    pressure (pages_total/pages_used) next to the scheduler gauges."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.serve.engine import LMEngineModel
+    from kubeflow_tpu.serve.model import BucketSpec
+    from kubeflow_tpu.serve.server import ModelServer
+
+    m = LMEngineModel(
+        "plm", None, config=CFG, max_batch=2, max_seq=64, chunk_steps=4,
+        max_new_tokens=6, eos_id=EOS,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+        kv_pool_tokens=16 * 8, page_size=16,
+    )
+    server = ModelServer([m])
+
+    async def run():
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post(
+                "/v1/models/plm:predict",
+                json={"instances": [{"input_ids": [5, 6, 7]}]},
+            )
+            assert r.status == 200
+            text = await (await client.get("/metrics")).text()
+            assert 'kubeflow_tpu_engine_kv_pages_total{model="plm"} 7' in text
+            assert 'kubeflow_tpu_engine_kv_pages_used{model="plm"}' in text
+            assert 'kubeflow_tpu_engine_kv_pages_used_peak{model="plm"}' in text
+
+    try:
+        asyncio.run(run())
+    finally:
+        m.unload()
