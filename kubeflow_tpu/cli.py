@@ -17,6 +17,8 @@ Subcommands:
 - ``kft serve -f <path>`` — materialise an InferenceService manifest:
   storage-initialize the model, resolve its runtime from the default
   registry, serve REST (+ optional gRPC) until SIGINT.
+- ``kft models``       — model registry verbs (list/show/register/promote/
+  rollback/lineage) over the store at ``--root``/``KFT_REGISTRY_ROOT``.
 - ``kft doctor``       — accelerator liveness via the subprocess probe
   (never hangs on a wedged tunnel) + device inventory.
 - ``kft version``.
@@ -387,6 +389,89 @@ def _cmd_pipeline(args) -> int:
     return 0 if rec["state"] == "SUCCEEDED" else 1
 
 
+def _cmd_models(args) -> int:
+    """Model-registry verbs (the model-registry CLI/BFF analog): operate
+    in-process on the store under ``--root`` / ``KFT_REGISTRY_ROOT``."""
+    from kubeflow_tpu.registry import stages as reg_stages
+    from kubeflow_tpu.registry.store import ModelStore
+
+    root = args.root or os.environ.get("KFT_REGISTRY_ROOT")
+    if not root:
+        raise SystemExit(
+            "kft models: need --root or KFT_REGISTRY_ROOT (registry dir)"
+        )
+    store = ModelStore(root)
+
+    def need(what, value):
+        if value is None:
+            raise SystemExit(f"kft models {args.action}: {what} is required")
+        return value
+
+    try:
+        if args.action == "list":
+            for m in store.list_models():
+                stages = " ".join(
+                    f"{s}=v{v}" for s, v in sorted(m.stages.items())
+                ) or "-"
+                print(f"{m.name}\tversions={m.latest_version}\t{stages}")
+            return 0
+        if args.action == "show":
+            name = need("NAME", args.name)
+            for v in store.list_versions(name):
+                print(
+                    f"v{v.version}\t{v.stage}\t{v.sha256[:12]}\t"
+                    f"{json.dumps(v.metadata, sort_keys=True)}"
+                )
+            return 0
+        if args.action == "register":
+            name = need("NAME", args.name)
+            path = need("--path", args.path)
+            mv = store.register_version(
+                name, path, stage=args.stage,
+                metadata=_parse_params(args.param),
+            )
+            print(f"{mv.ref}: sha256={mv.sha256[:12]} stage={mv.stage}")
+            return 0
+        if args.action == "promote":
+            name = need("NAME", args.name)
+            version = need("--version", args.version)
+            out = reg_stages.promote(
+                store, name, int(version), args.stage or "production"
+            )
+            print(
+                f"{name}@{out['stage']}: v{out['version']}"
+                + (f" (was v{out['previous']})" if out["previous"] else "")
+            )
+            return 0
+        if args.action == "rollback":
+            name = need("NAME", args.name)
+            out = reg_stages.rollback(store, name, args.stage or "production")
+            print(
+                f"{name}@{out['stage']}: "
+                + (f"v{out['version']}" if out["version"] else "(empty)")
+                + f" (rolled back v{out['previous']})"
+            )
+            return 0
+        # lineage
+        name = need("NAME", args.name)
+        versions = (
+            [store.get_version(name, int(args.version))]
+            if args.version else store.list_versions(name)
+        )
+        for v in versions:
+            for e in store.lineage_of(name, v.version):
+                print(
+                    f"v{v.version}\t{e.kind}\t{e.ref}\t"
+                    f"{json.dumps(e.metadata, sort_keys=True)}"
+                )
+        return 0
+    except (KeyError, ValueError, FileNotFoundError, RuntimeError) as e:
+        print(f"kft models {args.action}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        store.close()
+
+
 def _cmd_doctor(args) -> int:
     from kubeflow_tpu.core.deviceprobe import UNREACHABLE, probe_backend
 
@@ -459,6 +544,29 @@ def main(argv: list[str] | None = None) -> int:
                     help="local run: artifact/cache root (default: tmpdir)")
     pl.add_argument("--timeout", type=float, default=300.0)
     pl.set_defaults(fn=_cmd_pipeline)
+
+    mo = sub.add_parser(
+        "models", help="model registry: list/register/promote/lineage"
+    )
+    mo.add_argument(
+        "action",
+        choices=("list", "show", "register", "promote", "rollback",
+                 "lineage"),
+    )
+    mo.add_argument("name", nargs="?", default=None,
+                    help="registered model name")
+    mo.add_argument("--root", default=None,
+                    help="registry root dir (default: $KFT_REGISTRY_ROOT)")
+    mo.add_argument("--path", default=None,
+                    help="register: model payload file/dir to ingest")
+    mo.add_argument("--version", default=None,
+                    help="promote/lineage: version number")
+    mo.add_argument("--stage", default=None,
+                    help="register/promote/rollback: stage "
+                         "(default: production for promote/rollback)")
+    mo.add_argument("-p", "--param", action="append", default=[],
+                    help="register: metadata key=value (repeatable)")
+    mo.set_defaults(fn=_cmd_models)
 
     d = sub.add_parser("doctor", help="accelerator liveness + inventory")
     d.add_argument("--timeout", type=float, default=120.0)

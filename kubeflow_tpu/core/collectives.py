@@ -21,7 +21,20 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-shard_map = jax.shard_map
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.5: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, check_vma: bool = True, **kwargs):
+        return _shard_map_exp(f, check_rep=check_vma, **kwargs)
+
+try:
+    axis_size = lax.axis_size
+except AttributeError:  # jax < 0.5: psum of a unit constant folds to the
+    # axis size as a concrete int at trace time
+    def axis_size(axis_name):
+        return lax.psum(1, axis_name)
 
 
 # --------------------------------------------------------------------------- #
@@ -54,7 +67,7 @@ def ring_shift(x, axis: str, *, shift: int = 1):
     """Rotate shards around the axis ring with ``ppermute`` — the building
     block of ring attention (KV rotation) and pipeline stage handoff.
     ICI tori make each hop a physical-neighbor transfer."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 

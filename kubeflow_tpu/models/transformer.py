@@ -31,7 +31,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from kubeflow_tpu.core.mesh import Axis
+from kubeflow_tpu.core.collectives import shard_map
+
+from kubeflow_tpu.core.mesh import Axis, current_mesh
 from kubeflow_tpu.ops.flash_attention import flash_attention, reference_attention
 from kubeflow_tpu.parallel.expert import MoEConfig, moe_ffn
 from kubeflow_tpu.parallel.ring_attention import ring_attention_local
@@ -135,7 +137,7 @@ class TransformerConfig:
 
 def _act_constraint(x: jax.Array, *, seq_dim: int = 1) -> jax.Array:
     """(batch, seq, d) activations: batch over data+fsdp, seq over seq."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = current_mesh()
     if mesh.empty or Axis.DATA not in mesh.axis_names:
         return x
     spec = [None] * x.ndim
@@ -376,7 +378,7 @@ class Attention(nn.Module):
 
 def dispatch_attention(q, k, v, cfg: TransformerConfig, *, segment_ids=None):
     """Route to the configured attention strategy. q/k/v: (B, H, S, D)."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = current_mesh()
     kw = dict(
         causal=cfg.causal,
         block_q=cfg.attn_block_q,
@@ -438,7 +440,7 @@ def dispatch_attention(q, k, v, cfg: TransformerConfig, *, segment_ids=None):
             "attn_impl='flash' cannot shard the seq axis; use 'ring' or "
             "'ulysses' for sequence parallelism"
         )
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec, seg_spec),

@@ -212,12 +212,13 @@ class Histogram(_Metric):
             total, count = child.total, child.count
         for le, n in zip(child.buckets, counts):
             cum += n
+            le_label = 'le="%s"' % _fmt_value(le)
             lines.append(
-                f"{self.name}_bucket"
-                f"{_fmt_labels(key, f'le=\"{_fmt_value(le)}\"')} {cum}"
+                f"{self.name}_bucket{_fmt_labels(key, le_label)} {cum}"
             )
+        inf_label = 'le="+Inf"'
         lines.append(
-            f"{self.name}_bucket{_fmt_labels(key, 'le=\"+Inf\"')} {count}"
+            f"{self.name}_bucket{_fmt_labels(key, inf_label)} {count}"
         )
         lines.append(f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(total)}")
         lines.append(f"{self.name}_count{_fmt_labels(key)} {count}")

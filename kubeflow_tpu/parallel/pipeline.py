@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeflow_tpu.core.collectives import axis_size, shard_map
+
 from kubeflow_tpu.core.mesh import Axis
 
 
@@ -39,7 +41,7 @@ def spmd_pipeline_local(
     every rank — the last stage's results are broadcast back with a psum
     over one-hot masking).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     s = lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
@@ -116,7 +118,7 @@ def pipeline_apply(
             stage_fn, squeezed, xm_local, axis_name=axis_name
         )
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, x_spec),
@@ -221,7 +223,7 @@ def pipeline_value_and_grad(
 
     def local(params_stage, xm_local):
         params = jax.tree_util.tree_map(lambda p: p[0], params_stage)
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         s = lax.axis_index(axis_name)
         m = xm_local.shape[0]
         mb_shape = xm_local.shape[1:]
@@ -301,7 +303,7 @@ def pipeline_value_and_grad(
         grads = jax.tree_util.tree_map(lambda g: g[None], grads)
         return loss, grads
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, x_spec),

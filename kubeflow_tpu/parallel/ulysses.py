@@ -14,6 +14,8 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeflow_tpu.core.collectives import axis_size, shard_map
+
 from kubeflow_tpu.core.mesh import Axis
 from kubeflow_tpu.ops.flash_attention import flash_attention
 from kubeflow_tpu.parallel.ring_attention import global_seg_operand
@@ -43,7 +45,7 @@ def ulysses_attention_local(
             segment_ids, axis_name, axis=1, tiled=True
         )
         seg_kw = {"q_segment_ids": full_seg, "kv_segment_ids": full_seg}
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return flash_attention(
             q, k, v, causal=causal, scale=scale, **seg_kw,
@@ -92,7 +94,7 @@ def ulysses_attention(
             interpret=interpret,
         )
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
         out_specs=spec, check_vma=False,
     )

@@ -79,8 +79,31 @@ def execute(task: dict) -> dict:
         if out.kind == "parameter":
             outputs[out.name] = {"value": ret}
         else:
-            outputs[out.name] = output_artifacts[out.name].to_dict()
+            art = output_artifacts[out.name]
+            if art.TYPE == "system.Model":
+                _stamp_model_digest(art)
+            outputs[out.name] = art.to_dict()
     return outputs
+
+
+def _stamp_model_digest(art: Artifact) -> None:
+    """Record the written payload's sha256 in the artifact metadata (the
+    launcher-side half of model governance): the registry can verify its
+    ingest against the hash computed where the bytes were produced, and
+    a serving fetch can pin it. Single-file payloads only — directory
+    digests are manifest-shaped and belong to the registry."""
+    try:
+        path = art.path
+    except ValueError:
+        return  # non-local uri: the producing side cannot hash it
+    if os.path.isfile(path):
+        import hashlib
+
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        art.metadata.setdefault("sha256", h.hexdigest())
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -100,6 +100,7 @@ class PipelineRunner:
         cache: StepCache | None = None,
         lineage: LineageStore | None = None,
         cluster: Any | None = None,       # orchestrator LocalCluster, for TPU steps
+        model_registry: Any | None = None,  # registry.store.ModelStore
         max_parallel: int = 8,
         job_timeout_s: float = 600.0,
     ):
@@ -107,6 +108,7 @@ class PipelineRunner:
         self.cache = cache
         self.lineage = lineage or LineageStore()
         self.cluster = cluster
+        self.model_registry = model_registry
         self.max_parallel = max_parallel
         self.job_timeout_s = job_timeout_s
 
@@ -234,6 +236,8 @@ class PipelineRunner:
                 res.cache_hit = True
                 res.state = SUCCEEDED
                 self._record_artifacts(exec_id, kinds, inputs, cached)
+                self._register_model_outputs(ir, task, run_id, cached,
+                                             cache_hit=True)
                 self.lineage.finish_execution(exec_id, state=SUCCEEDED,
                                               cache_hit=True)
                 return
@@ -262,6 +266,8 @@ class PipelineRunner:
                 if task.cache_enabled and self.cache is not None:
                     self.cache.record(key, outputs)
                 self._record_artifacts(exec_id, kinds, inputs, outputs)
+                self._register_model_outputs(ir, task, run_id, outputs,
+                                             cache_hit=False)
                 self.lineage.finish_execution(exec_id, state=SUCCEEDED)
                 return
             except Exception as e:
@@ -317,6 +323,47 @@ class PipelineRunner:
                 f"step job {spec.name} phase={status.phase}: {detail}")
         with open(os.path.join(workdir, "outputs.json")) as f:
             return json.load(f)
+
+    def _register_model_outputs(self, ir: PipelineIR, task: TaskIR,
+                                run_id: str, outputs: dict,
+                                *, cache_hit: bool) -> None:
+        """Auto-register declared ``system.Model`` outputs into the model
+        registry with run lineage (the KFP → model-registry handoff).
+        Components pick the registered name with
+        ``model.metadata["register_as"]``; the default is
+        ``<pipeline>/<output-name>``. Registration is bookkeeping — a
+        registry failure logs, it does not fail the run."""
+        if self.model_registry is None:
+            return
+        for name, v in outputs.items():
+            if not (isinstance(v, dict) and v.get("type") == "system.Model"
+                    and v.get("uri")):
+                continue
+            uri = v["uri"]
+            local = uri[len("file://"):] if uri.startswith("file://") else uri
+            if "://" in local or not os.path.exists(local):
+                continue  # remote or never-written output — nothing to ingest
+            meta = dict(v.get("metadata") or {})
+            reg_name = meta.pop("register_as", None) or f"{ir.name}/{name}"
+            try:
+                self.model_registry.register_version(
+                    reg_name,
+                    local,
+                    source_uri=uri,
+                    metadata={**meta, "pipeline": ir.name, "task": task.name,
+                              "cache_hit": cache_hit},
+                    lineage=[(
+                        "pipeline_run",
+                        run_id,
+                        {"pipeline": ir.name, "task": task.name,
+                         "output": name, "cache_hit": cache_hit},
+                    )],
+                )
+            except Exception:
+                logger.exception(
+                    "registry: failed to register %s output %s of run %s",
+                    task.name, name, run_id,
+                )
 
     def _record_artifacts(self, exec_id: int, kinds: dict,
                           inputs: dict, outputs: dict) -> None:

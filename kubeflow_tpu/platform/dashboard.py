@@ -13,6 +13,8 @@ Read API:
 - ``GET /api/profiles``     → profiles with live quota usage
 - ``GET /api/notebooks``    → notebook phases + idle times
 - ``GET /api/tensorboards`` → board phases + urls
+- ``GET /api/models``       → registered models with stage holders
+- ``GET /api/models/{name}/versions`` → versions + lineage edges
 
 CRUD (the web-app analog):
 - ``POST /api/jobs``              body = CRD manifest (any known kind)
@@ -57,6 +59,7 @@ class DashboardServer(ThreadedAiohttpServer):
         lineage=None,       # pipelines.metadata.LineageStore → /api/pipelines
         pipeline_api=None,  # pipelines.api.PipelineAPIServer → DAG view
         volumes=None,       # platform.volumes.VolumeController → /api/volumes
+        registry=None,      # registry.store.ModelStore → /api/models
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -69,6 +72,7 @@ class DashboardServer(ThreadedAiohttpServer):
         self.lineage = lineage
         self.pipeline_api = pipeline_api
         self.volumes = volumes
+        self.registry = registry
 
     # -- views ---------------------------------------------------------- #
 
@@ -169,6 +173,37 @@ class DashboardServer(ThreadedAiohttpServer):
             for t in self.tune_db.load_trials(name)
         ]
 
+    def models_view(self) -> list[dict]:
+        """Registered models with stage holders (the model-registry UI
+        analog): name, latest, and which version sits in each stage."""
+        if self.registry is None:
+            return []
+        return [
+            {
+                "name": m.name,
+                "description": m.description,
+                "latest": m.latest_version,
+                "production": m.stages.get("production"),
+                "staging": m.stages.get("staging"),
+                "updated": m.updated,
+            }
+            for m in self.registry.list_models()
+        ]
+
+    def model_versions_view(self, name: str) -> list[dict]:
+        if self.registry is None:
+            return []
+        return [
+            {
+                **v.to_dict(),
+                "lineage": [
+                    e.to_dict()
+                    for e in self.registry.lineage_of(name, v.version)
+                ],
+            }
+            for v in self.registry.list_versions(name)
+        ]
+
     def pipelines_view(self) -> list[dict]:
         return [] if self.lineage is None else self.lineage.runs()
 
@@ -201,6 +236,7 @@ class DashboardServer(ThreadedAiohttpServer):
             "tensorboards": len(self.tensorboards_view()),
             "experiments": len(self.experiments_view()),
             "pipeline_runs": len(self.pipelines_view()),
+            "models": len(self.models_view()),
             "fleet": {
                 "slices": len(self.cluster.fleet.snapshot()),
                 "total_chips": self.cluster.fleet.total_chips(),
@@ -402,6 +438,15 @@ class DashboardServer(ThreadedAiohttpServer):
                 )
             ),
         )
+        app.router.add_get("/api/models", handler(self.models_view))
+        app.router.add_get(
+            "/api/models/{name:.+}/versions",
+            guard(
+                lambda r: _json(
+                    self.model_versions_view(r.match_info["name"])
+                )
+            ),
+        )
         app.router.add_get("/api/pipelines", handler(self.pipelines_view))
         app.router.add_get(
             "/api/pipelines/{run_id}/tasks",
@@ -463,7 +508,7 @@ _INDEX_HTML = """<!doctype html>
 <header><h1>kubeflow-tpu</h1><nav id="nav"></nav></header>
 <main id="main"></main>
 <script>
-const tabs=["summary","jobs","experiments","pipelines","notebooks","volumes","tensorboards","profiles"];
+const tabs=["summary","jobs","experiments","pipelines","models","notebooks","volumes","tensorboards","profiles"];
 let tab="summary";
 const $=(h)=>{const d=document.createElement("div");d.innerHTML=h;return d};
 const esc=(s)=>String(s).replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
@@ -507,6 +552,10 @@ async function render(){nav();const m=document.getElementById("main");m.textCont
    run_id:raw(`<a href="#" onclick="tasks('${uenc(r.run_id)}');return false">${esc(r.run_id)}</a>`)}));
   m.innerHTML=table(rows,["run_id","state","tasks","succeeded","failed","cache_hits"])+
    `<div id="dag" hidden style="background:#fff;border:1px solid #e4e7ec;margin-top:10px;overflow:auto"></div><pre id="detail" hidden></pre>`}
+ if(tab==="models"){const rows=(await j("/api/models")).map(r=>({...r,
+   name:raw(`<a href="#" onclick="versions('${uenc(r.name)}');return false">${esc(r.name)}</a>`),
+   production:r.production??"—",staging:r.staging??"—"}));
+  m.innerHTML=table(rows,["name","latest","production","staging","description"])+`<pre id="detail" hidden></pre>`}
  if(tab==="notebooks"){const rows=(await j("/api/notebooks")).map(r=>({...r,phase:pill(r.phase)}));
   m.innerHTML=`<div class="bar"><input id="nb" placeholder="name">
     <button class="act" onclick="mknb()">create notebook</button></div>`+
@@ -533,6 +582,8 @@ async function logs(uid){const p=document.getElementById("logs");p.hidden=false;
  p.textContent=await j(`/api/jobs/${uid}/logs`)}
 async function trials(name){const p=document.getElementById("detail");p.hidden=false;
  p.textContent=JSON.stringify(await j(`/api/experiments/${name}/trials`),null,1)}
+async function versions(name){const p=document.getElementById("detail");p.hidden=false;
+ p.textContent=JSON.stringify(await j(`/api/models/${name}/versions`),null,1)}
 async function tasks(run){const p=document.getElementById("detail");p.hidden=false;
  const g=document.getElementById("dag");
  try{const dag=await j(`/api/pipelines/${run}/dag`);

@@ -1,0 +1,172 @@
+"""Model Registry REST API.
+
+Reference analog: [model-registry]'s REST surface (UNVERIFIED, mount
+empty, SURVEY.md §0) — upstream serves ``/api/model_registry/v1alpha3``
+with registered_models / model_versions resources; route shapes here
+follow that naming (the `pipelines/api.py` idiom: aiohttp on a daemon
+thread, KeyError→404 / ValueError→400 guard).
+
+Registration POSTs take a server-local ``path`` — this platform runs
+in-process, so "upload" is an ingest of a path the trainer already
+wrote. Promotion and rollback are POST actions mirroring the
+``:promote`` / ``:rollback`` CLI verbs.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.obs.webhost import ThreadedAiohttpServer
+from kubeflow_tpu.registry import stages as _stages
+from kubeflow_tpu.registry.store import ModelStore
+
+_PFX = "/api/model_registry/v1alpha3"
+
+
+class ModelRegistryAPIServer(ThreadedAiohttpServer):
+    """The write path for the registry: everything the dashboard's
+    read-only ``/api/models`` view cannot do."""
+
+    thread_name = "kft-model-registry"
+
+    def __init__(
+        self, store: ModelStore, *, host: str = "127.0.0.1", port: int = 0
+    ):
+        super().__init__(host=host, port=port)
+        self.store = store
+
+    def _make_app(self):
+        from aiohttp import web
+
+        def guard(fn):
+            """KeyError → 404, ValueError/TypeError → 400 — the same
+            error contract as the pipelines API."""
+
+            async def h(request):
+                try:
+                    return web.json_response(await fn(request))
+                except KeyError as e:
+                    return web.json_response({"error": str(e)}, status=404)
+                except (ValueError, TypeError, FileNotFoundError) as e:
+                    return web.json_response(
+                        {"error": f"{type(e).__name__}: {e}"}, status=400
+                    )
+
+            return h
+
+        async def list_models(_request):
+            return {
+                "registered_models": [
+                    m.to_dict() for m in self.store.list_models()
+                ]
+            }
+
+        async def create_model(request):
+            body = await request.json()
+            if "name" not in body:
+                raise ValueError("registered model needs 'name'")
+            m = self.store.create_model(
+                body["name"], body.get("description", "")
+            )
+            return m.to_dict()
+
+        async def get_model(request):
+            return self.store.get_model(request.match_info["name"]).to_dict()
+
+        async def list_versions(request):
+            name = request.match_info["name"]
+            return {
+                "model_versions": [
+                    v.to_dict() for v in self.store.list_versions(name)
+                ]
+            }
+
+        async def create_version(request):
+            name = request.match_info["name"]
+            body = await request.json()
+            if "path" not in body:
+                raise ValueError(
+                    "version registration needs 'path' (server-local"
+                    " payload to ingest)"
+                )
+            lineage = [
+                (e["kind"], e["ref"], e.get("metadata", {}))
+                for e in body.get("lineage", [])
+            ]
+            mv = self.store.register_version(
+                name,
+                body["path"],
+                source_uri=body.get("source_uri", ""),
+                metadata=body.get("metadata"),
+                stage=body.get("stage"),
+                lineage=lineage,
+            )
+            return mv.to_dict()
+
+        async def get_version(request):
+            return self.store.get_version(
+                request.match_info["name"], int(request.match_info["v"])
+            ).to_dict()
+
+        async def promote(request):
+            body = await request.json()
+            if "stage" not in body:
+                raise ValueError("promote needs 'stage'")
+            return _stages.promote(
+                self.store,
+                request.match_info["name"],
+                int(request.match_info["v"]),
+                body["stage"],
+            )
+
+        async def rollback(request):
+            return _stages.rollback(
+                self.store,
+                request.match_info["name"],
+                request.match_info["stage"],
+            )
+
+        async def lineage(request):
+            name = request.match_info["name"]
+            v = int(request.match_info["v"])
+            return {
+                "lineage": [
+                    e.to_dict() for e in self.store.lineage_of(name, v)
+                ]
+            }
+
+        async def healthz(_request):
+            return web.json_response({"ok": True})
+
+        app = web.Application()
+        app.router.add_get("/healthz", healthz)
+        app.router.add_get(f"{_PFX}/registered_models", guard(list_models))
+        app.router.add_post(f"{_PFX}/registered_models", guard(create_model))
+        # model names may contain "/" (pipeline-scoped registrations) —
+        # accept them with a greedy path segment
+        app.router.add_get(
+            f"{_PFX}/registered_models/{{name:.+}}/versions/{{v:\\d+}}/lineage",
+            guard(lineage),
+        )
+        app.router.add_post(
+            f"{_PFX}/registered_models/{{name:.+}}/versions/{{v:\\d+}}:promote",
+            guard(promote),
+        )
+        app.router.add_post(
+            f"{_PFX}/registered_models/{{name:.+}}/stages/{{stage}}:rollback",
+            guard(rollback),
+        )
+        app.router.add_get(
+            f"{_PFX}/registered_models/{{name:.+}}/versions/{{v:\\d+}}",
+            guard(get_version),
+        )
+        app.router.add_get(
+            f"{_PFX}/registered_models/{{name:.+}}/versions",
+            guard(list_versions),
+        )
+        app.router.add_post(
+            f"{_PFX}/registered_models/{{name:.+}}/versions",
+            guard(create_version),
+        )
+        app.router.add_get(
+            f"{_PFX}/registered_models/{{name:.+}}", guard(get_model)
+        )
+        return app

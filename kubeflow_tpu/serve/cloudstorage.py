@@ -450,6 +450,27 @@ def _webhdfs_json(endpoint: str, path: str, op: str) -> dict:
         return json.loads(resp.read())
 
 
+def _check_path_suffix(suffix, *, where: str) -> str:
+    """A LISTSTATUS ``pathSuffix`` must be one plain path component.
+    The NameNode is a remote service whose response is untrusted input:
+    a hostile/compromised endpoint returning ``..`` or separator-bearing
+    suffixes would otherwise steer the fetched bytes outside the staging
+    directory (path traversal in the model fetcher)."""
+    if (
+        not isinstance(suffix, str)
+        or suffix in (".", "..")
+        or "/" in suffix
+        or "\\" in suffix
+        or os.sep in suffix
+        or "\0" in suffix
+    ):
+        raise PermanentError(
+            f"WebHDFS returned unsafe pathSuffix {suffix!r} under "
+            f"{where!r} — refusing (possible path traversal)"
+        )
+    return suffix
+
+
 def _webhdfs_walk(endpoint: str, path: str) -> list[str]:
     """Every FILE path under ``path``, recursive LISTSTATUS."""
     out: list[str] = []
@@ -460,9 +481,10 @@ def _webhdfs_walk(endpoint: str, path: str) -> list[str]:
             "FileStatuses"
         ]["FileStatus"]
         for st in statuses:
+            suffix = st["pathSuffix"]
             child = (
-                f"{cur.rstrip('/')}/{st['pathSuffix']}"
-                if st["pathSuffix"] else cur
+                f"{cur.rstrip('/')}/{_check_path_suffix(suffix, where=cur)}"
+                if suffix else cur
             )
             if st["type"] == "DIRECTORY":
                 stack.append(child)
@@ -504,8 +526,19 @@ def _fetch_hdfs(uri: str, staging: str) -> str:
     )
     base = path.rstrip("/") + "/"
     os.makedirs(root, exist_ok=True)
+    real_root = os.path.realpath(root)
     for fp in files:
         local = os.path.join(root, fp[len(base):])
+        # belt over the pathSuffix braces: whatever the walk produced,
+        # the resolved write target must stay under the staging root
+        real_local = os.path.realpath(local)
+        if real_local != real_root and not real_local.startswith(
+            real_root + os.sep
+        ):
+            raise PermanentError(
+                f"WebHDFS listing resolved {fp!r} to {real_local!r}, "
+                f"outside staging root {real_root!r} — refusing"
+            )
         os.makedirs(os.path.dirname(local), exist_ok=True)
         http_get_to_file(open_url(fp), local)
     return root

@@ -183,7 +183,12 @@ def _restore_lm_params(storage_path: str):
         # surface (corrupt step, version mismatch), not be masked by a
         # nonsensical bare-layout fallback error
         try:
-            tree = mgr.restore(step)
+            try:
+                tree = mgr.restore(step)
+            except (KeyError, ValueError):
+                # older orbax can't infer the handler from saved metadata
+                # and needs the restore args spelled out
+                tree = mgr.restore(step, args=ocp.args.StandardRestore())
         except Exception as e:
             raise RuntimeError(
                 f"LM storage_path {path!r} is a train checkpoint "

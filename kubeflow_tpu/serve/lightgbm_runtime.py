@@ -73,8 +73,23 @@ def _parse_kv_block(lines: list[str], start: int) -> tuple[dict, int]:
 
 
 def _le_to_lt(thresholds: np.ndarray) -> np.ndarray:
-    """float32 thresholds t' with (v < t') ⇔ (v <= t) for all float32 v."""
-    t32 = thresholds.astype(np.float32)
+    """float32 thresholds t' with (v < t') ⇔ (v <= t) for all float32 v.
+
+    t' must be the smallest float32 STRICTLY greater than the double t,
+    so the double threshold is first rounded toward −inf to float32:
+    plain round-to-nearest can land ABOVE t (LightGBM thresholds are
+    midpoints between observed values, which tie and round up about half
+    the time), and nextafter from there admits v == float32(t) > t on
+    the left — a one-ULP misroute at exactly the serving values the
+    training data contained."""
+    t64 = np.asarray(thresholds, np.float64)
+    t32 = t64.astype(np.float32)
+    overshoot = t32.astype(np.float64) > t64
+    t32 = np.where(
+        overshoot,
+        np.nextafter(t32, np.float32(-np.inf), dtype=np.float32),
+        t32,
+    )
     return np.nextafter(t32, np.float32(np.inf), dtype=np.float32)
 
 

@@ -51,8 +51,24 @@ def _child(el, name):
 
 
 def _le_to_lt32(t: float) -> np.float32:
+    """Smallest float32 strictly greater than the double ``t``: round
+    toward −inf first — round-to-nearest can land above t, and nextafter
+    from there misroutes v == float32(t) by one ULP (same defect class
+    as lightgbm_runtime._le_to_lt)."""
     t32 = np.float32(t)
-    return np.nextafter(t32, np.float32(np.inf), dtype=np.float32)
+    if float(t32) > t:
+        t32 = np.nextafter(t32, np.float32(-np.inf))
+    return np.nextafter(t32, np.float32(np.inf))
+
+
+def _lt_to_lt32(t: float) -> np.float32:
+    """Smallest float32 >= the double ``t`` — the strict-< threshold for
+    PMML ``lessThan``: when round-to-nearest lands BELOW t, the bare
+    float32 cast excludes v == float32(t) < t from the left branch."""
+    t32 = np.float32(t)
+    if float(t32) < t:
+        t32 = np.nextafter(t32, np.float32(np.inf))
+    return t32
 
 
 class _Fields:
@@ -91,27 +107,49 @@ class _Fields:
 # --------------------------------------------------------------------------- #
 
 
+#: Deepest Node chain accepted in one TreeModel. Past this the lockstep
+#: device walk is pathological anyway (every tree pads to the max depth),
+#: and an unbounded chain used to die in an uncontrolled RecursionError
+#: around ~1000 levels instead of the module's documented fail-closed
+#: RuntimeError (ADVICE r5).
+_MAX_TREE_DEPTH = 512
+
+
 def _parse_tree(tree_el, fields: _Fields, *, path: str):
     """Flatten one binary TreeModel into node lists (feat, thresh, lc, rc,
     leaf values); returns (nodes, depth). PMML left child carries the
     lessOrEqual/lessThan predicate; the right child must be its
     complement (greaterThan/greaterOrEqual on the same field+value) or
-    a True catch-all."""
+    a True catch-all. Explicit work stack — document shape must never
+    drive the Python stack."""
     root_node = _child(tree_el, "Node")
     if root_node is None:
         raise RuntimeError(f"{path!r}: TreeModel has no root Node")
     nodes: list[dict] = []
-
-    def visit(el) -> int:
+    max_depth = 0
+    # (element, depth, parent index, child slot); popping the left child
+    # first preserves the preorder numbering of the old recursive visit
+    stack: list[tuple] = [(root_node, 0, -1, "")]
+    while stack:
+        el, d, parent, slot = stack.pop()
+        if d > _MAX_TREE_DEPTH:
+            raise RuntimeError(
+                f"{path!r}: Node chain deeper than {_MAX_TREE_DEPTH} — "
+                "refusing (degenerate tree; the padded lockstep walk "
+                "would be pathological)"
+            )
         idx = len(nodes)
         nodes.append({})
+        if parent >= 0:
+            nodes[parent][slot] = idx
         kids = _children(el, "Node")
         if not kids:
             score = el.get("score")
             if score is None:
                 raise RuntimeError(f"{path!r}: leaf Node without score")
             nodes[idx] = {"leaf": float(score)}
-            return idx
+            max_depth = max(max_depth, d)
+            continue
         if len(kids) != 2:
             raise RuntimeError(
                 f"{path!r}: only binary TreeModels are supported "
@@ -157,26 +195,15 @@ def _parse_tree(tree_el, fields: _Fields, *, path: str):
                     "non-complementary pair would silently drop cases"
                 )
         t = float(sp.get("value"))
-        thresh = _le_to_lt32(t) if op == "lessOrEqual" else np.float32(t)
+        thresh = _le_to_lt32(t) if op == "lessOrEqual" else _lt_to_lt32(t)
         nodes[idx] = {
             "feat": fields.feature(sp.get("field"), path=path),
             "thresh": float(thresh),
         }
-        li = visit(kids[0])
-        ri = visit(kids[1])
-        nodes[idx]["left"] = li
-        nodes[idx]["right"] = ri
-        return idx
+        stack.append((kids[1], d + 1, idx, "right"))
+        stack.append((kids[0], d + 1, idx, "left"))
 
-    visit(root_node)
-
-    def depth(i, d=0):
-        n = nodes[i]
-        if "leaf" in n:
-            return d
-        return max(depth(n["left"], d + 1), depth(n["right"], d + 1))
-
-    return nodes, depth(0)
+    return nodes, max_depth
 
 
 def _trees_to_booster(
@@ -224,6 +251,21 @@ def _trees_to_booster(
 # --------------------------------------------------------------------------- #
 
 
+def _require_regression_trees(function_name: str | None, *, path: str) -> None:
+    """Tree paths serve raw summed scores: a classification TreeModel /
+    MiningModel (majorityVote, per-class score distributions…) under
+    that walk would emit output with silently different shape and
+    meaning than pmmlserver — outside the envelope, fail closed."""
+    if function_name == "classification":
+        raise RuntimeError(
+            f"{path!r}: functionName='classification' tree models are "
+            "not a supported shape (the lockstep walk serves regression "
+            "scores; a category mapping would be silently dropped) — "
+            "export as regression or use a RegressionModel with "
+            "logit/softmax"
+        )
+
+
 def parse_pmml(path: str):
     """Returns (kind, predict_fn_builder_inputs). Two shapes:
     ("linear", (W, b, norm, num_feature)) or ("trees", BoosterArrays)."""
@@ -248,6 +290,18 @@ def parse_pmml(path: str):
         if not tables:
             raise RuntimeError(f"{path!r}: RegressionModel without tables")
         norm = reg.get("normalizationMethod", "none")
+        # functionName="classification" promises probabilities/categories;
+        # serving raw margins under that contract (norm none/unsupported)
+        # would silently change output meaning vs pmmlserver — fail closed
+        if reg.get("functionName") == "classification" and norm not in (
+            "logit", "softmax"
+        ):
+            raise RuntimeError(
+                f"{path!r}: classification RegressionModel with "
+                f"normalizationMethod={norm!r} is not a supported shape "
+                "(logit/softmax only) — raw margins would silently drop "
+                "the category mapping"
+            )
         F = len(fields.order)
         W = np.zeros((len(tables), F), np.float32)
         b = np.zeros((len(tables),), np.float32)
@@ -270,6 +324,7 @@ def parse_pmml(path: str):
 
     tm = _child(root, "TreeModel")
     if tm is not None:
+        _require_regression_trees(tm.get("functionName"), path=path)
         booster = _trees_to_booster(
             [_parse_tree(tm, fields, path=path)], [1.0], fields,
             objective="reg:squarederror", path=path,
@@ -278,6 +333,7 @@ def parse_pmml(path: str):
 
     mm = _child(root, "MiningModel")
     if mm is not None:
+        _require_regression_trees(mm.get("functionName"), path=path)
         seg = _child(mm, "Segmentation")
         if seg is None:
             raise RuntimeError(f"{path!r}: MiningModel without Segmentation")

@@ -10,6 +10,9 @@ This env has zero egress (SURVEY.md §0), so remote schemes are represented
 by a registry of fetchers: ``file://`` and bare paths work out of the box;
 ``gs://``/``s3://`` raise a clear error unless a fetcher is registered
 (tests register in-memory fakes; production registers real clients).
+``registry://name@stage-or-version`` resolves through the model registry
+(`kubeflow_tpu.registry.fetcher`) with the ref canonicalized to an exact
+content hash before the cache is consulted.
 
 Download discipline (VERDICT r3 missing #7 — the machinery, independent of
 which schemes are live):
@@ -140,9 +143,22 @@ def download(
     if not sep:
         scheme, rest = "file", storage_uri
 
+    if scheme == "registry":
+        # Model-registry refs are MUTABLE (`@production` moves on promote):
+        # canonicalize to the immutable `@vN` spelling BEFORE the cache
+        # check, so a stage move is never masked by a stale cached copy —
+        # and pin single-file payloads to the registered content hash.
+        from kubeflow_tpu.registry import fetcher as _registry  # self-registers
+
+        storage_uri, pinned = _registry.canonicalize(storage_uri)
+        rest = storage_uri.partition("://")[2]
+        if expected_sha256 is None:
+            expected_sha256 = pinned
+
     # cache check: the manifest records the SOURCE uri, so a same-named
     # artifact from a different uri is a miss (and the fetcher may name its
-    # output differently from the uri basename — check that path too)
+    # output differently from the uri basename — check that path too); an
+    # expected_sha256 additionally requires the cached bytes to hash to it
     name = os.path.basename(rest.rstrip("/")) or "model"
     for candidate in {os.path.join(dest_dir, name)} | {
         p[: -len(MANIFEST_SUFFIX)]
@@ -151,8 +167,14 @@ def download(
             if f.endswith(MANIFEST_SUFFIX)
         )
     }:
-        if expected_sha256 is None and verify(candidate, uri=storage_uri):
-            return candidate
+        if not verify(candidate, uri=storage_uri):
+            continue
+        if expected_sha256 is not None and not (
+            os.path.isfile(candidate)
+            and _sha256_file(candidate) == expected_sha256
+        ):
+            continue
+        return candidate
 
     last_err: Exception | None = None
     for attempt in range(max(1, retries)):
@@ -167,6 +189,12 @@ def download(
                     "http", "https", "s3", "gs", "hdfs"
                 ):
                     from . import cloudstorage  # noqa: F401  (self-registers)
+
+                    fetcher = _FETCHERS.get(scheme)
+                if fetcher is None and scheme == "registry":
+                    from kubeflow_tpu.registry import (  # noqa: F401
+                        fetcher as _registry_fetcher,     # self-registers
+                    )
 
                     fetcher = _FETCHERS.get(scheme)
                 if fetcher is None:

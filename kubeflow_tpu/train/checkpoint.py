@@ -42,11 +42,57 @@ class Checkpointer:
 
     # ------------------------------------------------------------------ #
 
-    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+    def save(
+        self, step: int, state: Any, *, force: bool = False,
+        register: Any | None = None,
+    ) -> bool:
         """Save if the interval policy says so (or ``force``). Async when
-        configured — overlaps the HBM→host copy with the next steps."""
-        return self._mgr.save(
+        configured — overlaps the HBM→host copy with the next steps.
+
+        ``register`` (a ``registry.spec.RegisterOnSave``) links training
+        into the model registry: a step that actually saved is ingested
+        as a new ModelVersion with a ``checkpoint`` lineage edge (and
+        optionally promoted to a stage). Registration waits for the
+        async save to be durable first — the registry must never hash a
+        half-written checkpoint. The registered version is exposed as
+        ``self.last_registered``."""
+        saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
+        )
+        if saved and register is not None:
+            self._mgr.wait_until_finished()
+            ckpt = self._step_dir(step)
+            self.last_registered = register.store.register_version(
+                register.name,
+                ckpt,
+                source_uri="file://" + ckpt,
+                metadata={**dict(register.metadata), "step": int(step)},
+                stage=register.stage,
+                lineage=[(
+                    "checkpoint",
+                    f"{self.config.directory}@{step}",
+                    {"step": int(step)},
+                )],
+            )
+        return saved
+
+    #: the ModelVersion produced by the most recent registering save
+    last_registered: Any | None = None
+
+    def _step_dir(self, step: int) -> str:
+        """The on-disk directory Orbax wrote for ``step``."""
+        base = Path(self.config.directory).absolute()
+        direct = base / str(step)
+        if direct.exists():
+            return str(direct)
+        # step-format prefixes/padding vary across Orbax configs: match
+        # any directory whose digits spell this step
+        for cand in sorted(base.iterdir()) if base.exists() else []:
+            digits = "".join(ch for ch in cand.name if ch.isdigit())
+            if cand.is_dir() and digits and int(digits) == int(step):
+                return str(cand)
+        raise FileNotFoundError(
+            f"no checkpoint directory for step {step} under {base}"
         )
 
     def latest_step(self) -> int | None:

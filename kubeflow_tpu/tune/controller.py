@@ -172,10 +172,24 @@ class ExperimentController:
         suggester: Suggester | None = None,
         seed: int = 0,
         db: "TrialDB | None" = None,
+        model_registry: Any | None = None,     # registry.store.ModelStore
+        register_best_as: str | None = None,
+        best_model_path: Callable[[Trial], "str | None"] | None = None,
     ):
         spec.validate()
+        if register_best_as is not None and (
+            model_registry is None or best_model_path is None
+        ):
+            raise ValueError(
+                "register_best_as needs model_registry and best_model_path"
+                " (a Trial → checkpoint-path mapping)"
+            )
         self.spec = spec
         self.runner = runner
+        self.model_registry = model_registry
+        self.register_best_as = register_best_as
+        self.best_model_path = best_model_path
+        self.registered_best: Any | None = None   # ModelVersion once saved
         self.suggester = suggester or make_suggester(spec, seed)
         self.trials: list[Trial] = []
         self._lock = threading.Lock()
@@ -274,7 +288,42 @@ class ExperimentController:
                     f.result()  # surface runner crashes
             for f in pending:  # drain in-flight trials before reporting
                 f.result()
+        self._register_best()
         return self.status(complete=True, reason=reason)
+
+    def _register_best(self) -> None:
+        """Katib → model-registry handoff: the winning trial's model
+        enters the registry as a new version with a ``tune_trial``
+        lineage edge carrying the full assignment and objective, so
+        "which hyperparameters produced the production model" stays
+        answerable after the experiment object is gone."""
+        if self.register_best_as is None:
+            return
+        best = self.optimal_trial()
+        if best is None:
+            return
+        path = self.best_model_path(best)
+        if not path:
+            return
+        self.registered_best = self.model_registry.register_version(
+            self.register_best_as,
+            path,
+            source_uri="file://" + str(path),
+            metadata={
+                "experiment": self.spec.name,
+                "trial_id": best.assignment.trial_id,
+                "parameters": dict(best.assignment.parameters),
+                "objective": best.metrics.get("__objective__"),
+            },
+            lineage=[(
+                "tune_trial",
+                f"{self.spec.name}/{best.assignment.trial_id}",
+                {
+                    "parameters": dict(best.assignment.parameters),
+                    "objective": best.metrics.get("__objective__"),
+                },
+            )],
+        )
 
     def _run_one(self, trial: Trial) -> None:
         trial.state = TrialState.RUNNING

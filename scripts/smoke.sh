@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Fast pre-tier-1 gate: syntax + import breakage fails in seconds, not
+# after minutes of pytest collection. Run from the repo root:
+#
+#   bash scripts/smoke.sh
+#
+# 1. `compileall` over the package — any SyntaxError fails the sweep.
+# 2. Import every `kubeflow_tpu` module on the CPU backend — a broken
+#    top-level import (missing dep, bad re-export, circular import)
+#    fails with the offending module named.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+# match tests/conftest.py: the tunneled-TPU plugin trigger must not be
+# able to wedge interpreter startup in a CPU-only sweep
+for k in $(env | grep -o '^PALLAS_AXON[^=]*' || true); do unset "$k"; done
+
+echo "== compileall =="
+python -m compileall -q kubeflow_tpu tests scripts bench.py
+
+echo "== import sweep =="
+python - <<'EOF'
+import importlib
+import pkgutil
+import sys
+
+import kubeflow_tpu
+
+failures = []
+mods = sorted(
+    m.name
+    for m in pkgutil.walk_packages(kubeflow_tpu.__path__, "kubeflow_tpu.")
+    # __main__ executes the CLI at import; everything else must be inert
+    if not m.name.endswith("__main__")
+)
+for name in mods:
+    try:
+        importlib.import_module(name)
+    except Exception as e:  # noqa: BLE001 — report every breakage at once
+        failures.append((name, f"{type(e).__name__}: {e}"))
+print(f"imported {len(mods) - len(failures)}/{len(mods)} modules")
+for name, err in failures:
+    print(f"FAIL {name}: {err}", file=sys.stderr)
+sys.exit(1 if failures else 0)
+EOF
+
+echo "smoke OK"

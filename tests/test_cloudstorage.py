@@ -579,3 +579,46 @@ def test_hdfs_single_file_and_missing(tmp_path, monkeypatch):
             storage.download(
                 "hdfs://nn/models/nope.bin", str(tmp_path / "mnt2")
             )
+
+
+def _hostile_webhdfs_app(suffix: str):
+    """A compromised NameNode returning a traversal-shaped pathSuffix in
+    LISTSTATUS (ADVICE r5: the listing is untrusted remote input)."""
+    from aiohttp import web
+
+    async def api(request: web.Request):
+        op = request.query.get("op")
+        if op == "GETFILESTATUS":
+            return web.json_response({"FileStatus": {
+                "type": "DIRECTORY", "pathSuffix": "", "length": 0}})
+        if op == "LISTSTATUS":
+            return web.json_response({"FileStatuses": {"FileStatus": [
+                {"pathSuffix": suffix, "type": "FILE", "length": 0},
+            ]}})
+        return _range_body(request, b"evil-bytes")
+
+    app = web.Application()
+    app.router.add_get("/webhdfs/v1{path:.*}", api)
+    return app
+
+
+@pytest.mark.parametrize("suffix", ["../escape.bin", "..", "a/b.bin", "x\\y"])
+def test_hdfs_rejects_traversal_path_suffix(tmp_path, monkeypatch, suffix):
+    """pathSuffix values containing separators or dot-dots must fail the
+    fetch closed — never write outside the staging root."""
+    import os
+
+    with _Server(_hostile_webhdfs_app(suffix)) as srv:
+        monkeypatch.setenv("WEBHDFS_ENDPOINT", f"http://127.0.0.1:{srv.port}")
+        with pytest.raises(FileNotFoundError, match="pathSuffix"):
+            storage.download(
+                "hdfs://namenode/models/m", str(tmp_path / "mnt"),
+                retries=1,
+            )
+    # nothing escaped: the parent of the staging dir holds only our dirs
+    outside = [
+        p for p in os.listdir(tmp_path)
+        if p not in ("mnt",) and not p.startswith(".")
+    ]
+    assert outside == []
+    assert not os.path.exists(tmp_path.parent / "escape.bin")

@@ -215,3 +215,28 @@ def test_rejects_unsupported_and_serves_e2e(tmp_path):
         out["predictions"], _oracle_margin(trees, x[:3])[:, 0],
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_le_boundary_nonrepresentable_midpoint(tmp_path):
+    """LightGBM thresholds are double midpoints between observed feature
+    values; when the training data is float32-typed that midpoint is NOT
+    float32-representable and round-to-nearest picks the UPPER value
+    about half the time. The <=→< conversion must round the double
+    toward −inf first (ADVICE r5): an input exactly equal to the upper
+    neighbour sits ABOVE the threshold and must route right."""
+    lo = float(np.nextafter(np.float32(1.0), np.float32(2.0)))   # 1+2^-23
+    hi = float(np.nextafter(np.float32(lo), np.float32(2.0)))    # 1+2^-22
+    t = (lo + hi) / 2.0                       # double midpoint, ties to hi
+    assert float(np.float32(t)) == hi         # round-half-even rounds UP
+    tree = {
+        "num_leaves": 2, "split_feature": [0], "threshold": [repr(t)],
+        "decision_type": [2], "left_child": [-1], "right_child": [-2],
+        "leaf_value": [10.0, 20.0],
+    }
+    p = tmp_path / "model.txt"
+    p.write_text(_to_text([tree], n_feat=1))
+    fwd = build_device_predict(parse_lightgbm_txt(str(p)))
+    x = np.asarray([[lo], [hi]], np.float32)
+    # lo <= t → left(10); hi > t → right(20). The pre-fix conversion sent
+    # hi left because nextafter started from the rounded-UP threshold.
+    np.testing.assert_allclose(np.asarray(fwd(x)), [10.0, 20.0])

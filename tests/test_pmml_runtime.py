@@ -303,3 +303,101 @@ def test_fuzz_forest_against_independent_walker(tmp_path):
         sum(eval_node(r, row) for r in roots) for row in x
     ]
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def _split_doc(op: str, t: float) -> str:
+    comp = {"lessOrEqual": "greaterThan", "lessThan": "greaterOrEqual"}[op]
+    return HEADER + f"""
+ <TreeModel functionName="regression">
+  <MiningSchema>
+   <MiningField name="y" usageType="target"/>
+   <MiningField name="x0"/><MiningField name="x1"/>
+  </MiningSchema>
+  <Node><True/>
+   <Node score="10.0">
+    <SimplePredicate field="x0" operator="{op}" value="{t!r}"/>
+   </Node>
+   <Node score="20.0">
+    <SimplePredicate field="x0" operator="{comp}" value="{t!r}"/>
+   </Node>
+  </Node>
+ </TreeModel>
+</PMML>
+"""
+
+
+def test_threshold_ulp_boundaries(tmp_path):
+    """Non-float32-representable thresholds must convert exactly
+    (ADVICE r5): round-to-nearest casts land a ULP off on ~half of all
+    midpoint thresholds, misrouting inputs equal to the rounded value."""
+    lo = float(np.nextafter(np.float32(1.0), np.float32(2.0)))
+    hi = float(np.nextafter(np.float32(lo), np.float32(2.0)))
+    # lessOrEqual, midpoint that ROUNDS UP in float32: hi > t goes right
+    t_up = (lo + hi) / 2.0
+    assert float(np.float32(t_up)) == hi
+    m = _runtime(tmp_path, _split_doc("lessOrEqual", t_up), "ule")
+    out = m.predict(np.asarray([[lo, 0.0], [hi, 0.0]], np.float32))
+    np.testing.assert_allclose(out, [10.0, 20.0])
+    # lessThan, midpoint that ROUNDS DOWN in float32: 1.0 < t goes left
+    t_dn = (1.0 + lo) / 2.0
+    assert float(np.float32(t_dn)) == 1.0
+    m = _runtime(tmp_path, _split_doc("lessThan", t_dn), "ult")
+    out = m.predict(np.asarray([[1.0, 0.0], [lo, 0.0]], np.float32))
+    np.testing.assert_allclose(out, [10.0, 20.0])
+
+
+def test_deep_node_chain_fails_closed(tmp_path):
+    """A degenerate ~1000-level Node chain must be a clear RuntimeError,
+    not an uncontrolled RecursionError (ADVICE r5)."""
+    depth = 1200
+    pair = (
+        '<Node score="0.0"><SimplePredicate field="x0"'
+        ' operator="lessOrEqual" value="0.25"/></Node>'
+        '<Node score="1.0"><SimplePredicate field="x0"'
+        ' operator="greaterThan" value="0.25"/></Node>'
+    )
+    for i in range(depth):
+        pair = (
+            f'<Node><SimplePredicate field="x0" operator="lessOrEqual"'
+            f' value="{i}.5"/>{pair}</Node>'
+            f'<Node score="1.0"><SimplePredicate field="x0"'
+            f' operator="greaterThan" value="{i}.5"/></Node>'
+        )
+    doc = HEADER + (
+        '<TreeModel functionName="regression"><Node><True/>'
+        + pair
+        + "</Node></TreeModel></PMML>"
+    )
+    p = tmp_path / "deep.pmml"
+    p.write_text(doc)
+    with pytest.raises(RuntimeError, match="deeper than"):
+        parse_pmml(str(p))
+
+
+def test_classification_shapes_fail_closed(tmp_path):
+    """functionName='classification' outside the supported envelope must
+    be a parse error, never silently-served raw margins (ADVICE r5)."""
+    # classification RegressionModel with normalizationMethod none
+    raw_margin = LOGISTIC.replace(' normalizationMethod="logit"', "")
+    (tmp_path / "rm.pmml").write_text(raw_margin)
+    with pytest.raises(RuntimeError, match="normalizationMethod"):
+        parse_pmml(str(tmp_path / "rm.pmml"))
+    # classification TreeModel
+    ctree = TREE.replace(
+        '<TreeModel functionName="regression">',
+        '<TreeModel functionName="classification">',
+    )
+    (tmp_path / "ct.pmml").write_text(ctree)
+    with pytest.raises(RuntimeError, match="classification"):
+        parse_pmml(str(tmp_path / "ct.pmml"))
+    # classification MiningModel of TreeModels
+    cmm = FOREST.replace(
+        '<MiningModel functionName="regression">',
+        '<MiningModel functionName="classification">',
+    )
+    (tmp_path / "cm.pmml").write_text(cmm)
+    with pytest.raises(RuntimeError, match="classification"):
+        parse_pmml(str(tmp_path / "cm.pmml"))
+    # the supported classification shape still loads
+    m = _runtime(tmp_path, LOGISTIC, "ok")
+    assert m.ready

@@ -1,0 +1,554 @@
+"""Model Registry (kubeflow_tpu/registry/): the governed path from
+"checkpoint on disk" to "promotable, servable, lineage-tracked artifact"
+— the kubeflow/model-registry analog (VERDICT.md §1 gap).
+
+Covers the ISSUE acceptance criteria: content dedup across versions,
+atomic stage promotion + rollback, ``registry://model@production``
+resolving the promoted version's exact bytes through ``serve.storage``,
+and lineage answering "which pipeline run / tune trial produced this
+version"."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.registry import ModelStore, stages
+from kubeflow_tpu.registry import fetcher as reg_fetcher
+from kubeflow_tpu.registry.store import set_default_store
+from kubeflow_tpu.pipelines.artifacts import ArtifactStore, Model
+from kubeflow_tpu.pipelines.compiler import compile_pipeline
+from kubeflow_tpu.pipelines.dsl import Output, component, pipeline
+from kubeflow_tpu.pipelines.runner import PipelineRunner
+from kubeflow_tpu.serve import storage
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ModelStore(str(tmp_path / "registry"))
+    set_default_store(s)
+    yield s
+    set_default_store(None)
+    s.close()
+
+
+def _payload(tmp_path, name: str, data: bytes) -> str:
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+# ------------------------------------------------------------------ store
+
+
+class TestStore:
+    def test_register_versions_and_dedup(self, store, tmp_path):
+        """Two versions with identical bytes share ONE blob; a third with
+        different bytes gets its own."""
+        a = _payload(tmp_path, "a.bin", b"weights-1")
+        v1 = store.register_version("bert", a)
+        v2 = store.register_version("bert", a)
+        assert (v1.version, v2.version) == (1, 2)
+        assert v1.sha256 == v2.sha256
+        assert len(os.listdir(store.blob_root)) == 1  # dedup'd
+        v3 = store.register_version(
+            "bert", _payload(tmp_path, "b.bin", b"weights-2")
+        )
+        assert v3.sha256 != v1.sha256
+        assert len(os.listdir(store.blob_root)) == 2
+        assert store.get_model("bert").latest_version == 3
+
+    def test_directory_payloads_hash_by_manifest(self, store, tmp_path):
+        d = tmp_path / "ckpt"
+        (d / "sub").mkdir(parents=True)
+        (d / "w.bin").write_bytes(b"www")
+        (d / "sub" / "meta.json").write_bytes(b"{}")
+        v1 = store.register_version("dir-model", str(d))
+        v2 = store.register_version("dir-model", str(d))
+        assert v1.sha256 == v2.sha256
+        (d / "w.bin").write_bytes(b"WWW")
+        v3 = store.register_version("dir-model", str(d))
+        assert v3.sha256 != v1.sha256
+        blob = store.blob_path(v1.sha256)
+        assert open(os.path.join(blob, "w.bin"), "rb").read() == b"www"
+
+    def test_resolve_selectors(self, store, tmp_path):
+        for i in (1, 2, 3):
+            store.register_version(
+                "m", _payload(tmp_path, f"p{i}", b"x%d" % i)
+            )
+        assert store.resolve("m").version == 3
+        assert store.resolve("m", "latest").version == 3
+        assert store.resolve("m", "v2").version == 2
+        assert store.resolve("m", "2").version == 2
+        store.set_alias("m", "champion", 1)
+        assert store.resolve("m", "champion").version == 1
+        with pytest.raises(KeyError, match="no version in stage"):
+            store.resolve("m", "production")
+        with pytest.raises(KeyError, match="cannot resolve"):
+            store.resolve("m", "nonsense")
+        with pytest.raises(ValueError, match="reserved"):
+            store.set_alias("m", "production", 1)
+
+    def test_unknown_model_and_version(self, store, tmp_path):
+        with pytest.raises(KeyError, match="not registered"):
+            store.get_model("ghost")
+        store.register_version("m", _payload(tmp_path, "p", b"x"))
+        with pytest.raises(KeyError, match="no version 9"):
+            store.get_version("m", 9)
+
+
+# ----------------------------------------------------------------- stages
+
+
+class TestStages:
+    def test_promote_rollback_atomic(self, store, tmp_path):
+        """Promotion archives the previous holder in the same transaction;
+        rollback restores it and re-archives the rolled-back version."""
+        for i in (1, 2):
+            store.register_version(
+                "m", _payload(tmp_path, f"p{i}", b"w%d" % i)
+            )
+        stages.promote(store, "m", 1, "production")
+        assert store.resolve("m", "production").version == 1
+        out = stages.promote(store, "m", 2, "production")
+        assert out["previous"] == 1
+        assert store.resolve("m", "production").version == 2
+        assert store.get_version("m", 1).stage == "archived"
+        # never two holders of an exclusive stage
+        holders = [
+            v for v in store.list_versions("m") if v.stage == "production"
+        ]
+        assert len(holders) == 1
+        back = stages.rollback(store, "m", "production")
+        assert back["version"] == 1 and back["previous"] == 2
+        assert store.resolve("m", "production").version == 1
+        assert store.get_version("m", 2).stage == "archived"
+        # rolling back past the first promotion empties the stage
+        stages.rollback(store, "m", "production")
+        with pytest.raises(KeyError, match="no version in stage"):
+            store.resolve("m", "production")
+        with pytest.raises(KeyError, match="no promotion history"):
+            stages.rollback(store, "m", "production")
+
+    def test_staging_and_production_independent(self, store, tmp_path):
+        for i in (1, 2):
+            store.register_version(
+                "m", _payload(tmp_path, f"p{i}", b"w%d" % i)
+            )
+        stages.promote(store, "m", 1, "production")
+        stages.promote(store, "m", 2, "staging")
+        model = store.get_model("m")
+        assert model.stages == {"production": 1, "staging": 2}
+
+    def test_invalid_transitions(self, store, tmp_path):
+        store.register_version("m", _payload(tmp_path, "p", b"w"))
+        with pytest.raises(ValueError, match="cannot promote"):
+            stages.promote(store, "m", 1, "none")
+        with pytest.raises(ValueError, match="cannot promote"):
+            stages.promote(store, "m", 1, "shipped")
+        with pytest.raises(KeyError):
+            stages.promote(store, "m", 5, "production")
+        with pytest.raises(ValueError, match="exclusive"):
+            stages.rollback(store, "m", "archived")
+
+    def test_register_with_stage_shortcut(self, store, tmp_path):
+        mv = store.register_version(
+            "m", _payload(tmp_path, "p", b"w"), stage="staging"
+        )
+        assert mv.stage == "staging"
+        assert store.resolve("m", "staging").version == 1
+
+
+# ---------------------------------------------------------------- fetcher
+
+
+class TestServeFetch:
+    def test_registry_uri_resolves_promoted_hash(self, store, tmp_path):
+        """The e2e acceptance row: register two versions, promote, fetch
+        via serve.storage — the bytes are the promoted version's, and a
+        promotion flip changes what the NEXT download resolves."""
+        store.register_version("m", _payload(tmp_path, "p1", b"old-weights"))
+        store.register_version("m", _payload(tmp_path, "p2", b"new-weights"))
+        stages.promote(store, "m", 1, "production")
+        mnt = str(tmp_path / "mnt")
+        local = storage.download("registry://m@production", mnt)
+        assert open(local, "rb").read() == b"old-weights"
+        assert storage.verify(local, uri="registry://m@v1")
+        # promotion moves production → a fresh download gets v2 (the old
+        # cached copy must not satisfy the new resolution)
+        stages.promote(store, "m", 2, "production")
+        local2 = storage.download("registry://m@production", mnt)
+        assert open(local2, "rb").read() == b"new-weights"
+        # rollback → v1 again, served from the still-valid v1 cache copy
+        stages.rollback(store, "m", "production")
+        local3 = storage.download("registry://m@production", mnt)
+        assert open(local3, "rb").read() == b"old-weights"
+        assert local3 == local
+
+    def test_fetch_by_version_and_latest(self, store, tmp_path):
+        store.register_version("m", _payload(tmp_path, "p1", b"v1-bytes"))
+        store.register_version("m", _payload(tmp_path, "p2", b"v2-bytes"))
+        mnt = str(tmp_path / "mnt")
+        assert open(
+            storage.download("registry://m@v1", mnt), "rb"
+        ).read() == b"v1-bytes"
+        assert open(
+            storage.download("registry://m", mnt), "rb"
+        ).read() == b"v2-bytes"
+
+    def test_directory_fetch(self, store, tmp_path):
+        d = tmp_path / "ckpt"
+        d.mkdir()
+        (d / "w.bin").write_bytes(b"dir-weights")
+        store.register_version("dm", str(d), stage="production")
+        local = storage.download(
+            "registry://dm@production", str(tmp_path / "mnt")
+        )
+        assert os.path.isdir(local)
+        assert open(os.path.join(local, "w.bin"), "rb").read() == b"dir-weights"
+
+    def test_corrupted_blob_fails_the_pinned_fetch(self, store, tmp_path):
+        """expected_sha256 pins single-file payloads end to end: a blob
+        corrupted at rest must not load."""
+        mv = store.register_version(
+            "m", _payload(tmp_path, "p", b"good"), stage="production"
+        )
+        with open(store.blob_path(mv.sha256), "wb") as f:
+            f.write(b"rotten")
+        with pytest.raises(RuntimeError, match="checksum mismatch|failed"):
+            storage.download(
+                "registry://m@production", str(tmp_path / "mnt"), retries=1
+            )
+
+    def test_unconfigured_registry_is_a_clear_error(self, tmp_path):
+        set_default_store(None)
+        os.environ.pop("KFT_REGISTRY_ROOT", None)
+        with pytest.raises(RuntimeError, match="no model registry"):
+            storage.download("registry://m@production", str(tmp_path / "mnt"))
+
+    def test_parse_ref(self):
+        assert reg_fetcher.parse_ref("registry://a/b@production") == (
+            "a/b", "production",
+        )
+        assert reg_fetcher.parse_ref("registry://m") == ("m", None)
+        with pytest.raises(ValueError):
+            reg_fetcher.parse_ref("gs://m@1")
+
+
+# ---------------------------------------------------------------- lineage
+
+
+class TestLineage:
+    def test_pipeline_run_auto_registers_with_lineage(self, store, tmp_path):
+        """A pipeline with a declared Model output auto-registers it; the
+        registry lineage names the producing run, and the run's id round-
+        trips against the pipelines LineageStore."""
+        @component
+        def train(model: Output[Model]):
+            with open(model.path, "wb") as f:
+                f.write(b"trained-weights")
+            model.metadata["register_as"] = "mnist"
+
+        @pipeline(name="train-pipe")
+        def pipe():
+            train()
+
+        runner = PipelineRunner(
+            artifact_store=ArtifactStore(str(tmp_path / "artifacts")),
+            model_registry=store,
+        )
+        res = runner.run(compile_pipeline(pipe))
+        assert res.state == "SUCCEEDED"
+        mv = store.resolve("mnist")
+        assert mv.version == 1
+        edges = store.lineage_of("mnist", 1)
+        assert [e.kind for e in edges] == ["pipeline_run"]
+        assert edges[0].ref == res.run_id
+        assert edges[0].metadata["task"] == "train"
+        # the executor stamped the payload hash where the bytes were made,
+        # and the registry ingest hashed to the same digest
+        assert mv.metadata.get("sha256") == mv.sha256
+        # cross-check against the pipelines lineage store
+        runs = runner.lineage.runs()
+        assert [r["run_id"] for r in runs] == [res.run_id]
+        # serve the registered model through the registry scheme
+        local = storage.download(
+            "registry://mnist@v1", str(tmp_path / "mnt")
+        )
+        assert open(local, "rb").read() == b"trained-weights"
+
+    def test_default_registered_name_is_pipeline_scoped(self, store, tmp_path):
+        @component
+        def fit(out_model: Output[Model]):
+            with open(out_model.path, "wb") as f:
+                f.write(b"w")
+
+        @pipeline(name="anon-pipe")
+        def pipe():
+            fit()
+
+        runner = PipelineRunner(
+            artifact_store=ArtifactStore(str(tmp_path / "artifacts")),
+            model_registry=store,
+        )
+        assert runner.run(compile_pipeline(pipe)).state == "SUCCEEDED"
+        assert store.resolve("anon-pipe/out_model").version == 1
+
+    def test_tune_controller_registers_winner(self, store, tmp_path):
+        from kubeflow_tpu.tune.controller import (
+            CallableTrialRunner,
+            ExperimentController,
+        )
+        from kubeflow_tpu.tune.spec import ExperimentSpec
+
+        ckpts = tmp_path / "trials"
+        ckpts.mkdir()
+
+        def objective(params):
+            val = -((params["x"] - 0.3) ** 2)
+            # each trial "writes a model"; the best one gets registered
+            (ckpts / f"x={params['x']}.bin").write_bytes(
+                json.dumps(params).encode()
+            )
+            return val
+
+        spec = ExperimentSpec.from_dict({
+            "name": "reg-exp",
+            "objective": {"type": "maximize", "metric": "score"},
+            "parameters": [
+                {"name": "x", "type": "double", "min": 0.0, "max": 1.0},
+            ],
+            "max_trial_count": 6,
+            "parallel_trial_count": 2,
+        })
+        ctrl = ExperimentController(
+            spec,
+            CallableTrialRunner(objective),
+            model_registry=store,
+            register_best_as="tuned-model",
+            best_model_path=lambda t: str(
+                ckpts / f"x={t.assignment.parameters['x']}.bin"
+            ),
+        )
+        status = ctrl.run()
+        assert status.optimal is not None
+        mv = store.resolve("tuned-model")
+        assert ctrl.registered_best is not None
+        assert mv.version == ctrl.registered_best.version
+        edges = store.lineage_of("tuned-model", mv.version)
+        assert edges[0].kind == "tune_trial"
+        assert edges[0].ref.startswith("reg-exp/")
+        assert mv.metadata["trial_id"] == status.optimal.assignment.trial_id
+
+    def test_register_best_requires_path_fn(self, store):
+        from kubeflow_tpu.tune.controller import (
+            CallableTrialRunner,
+            ExperimentController,
+        )
+        from kubeflow_tpu.tune.spec import ExperimentSpec
+
+        spec = ExperimentSpec.from_dict({
+            "name": "e",
+            "objective": {"type": "maximize", "metric": "m"},
+            "parameters": [
+                {"name": "x", "type": "double", "min": 0.0, "max": 1.0},
+            ],
+            "max_trial_count": 1,
+        })
+        with pytest.raises(ValueError, match="best_model_path"):
+            ExperimentController(
+                spec, CallableTrialRunner(lambda p: 0.0),
+                model_registry=store, register_best_as="m",
+            )
+
+
+# -------------------------------------------------------------------- api
+
+
+class TestAPI:
+    def _req(self, base, method, path, body=None):
+        req = urllib.request.Request(
+            base + path,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_rest_round_trip(self, store, tmp_path):
+        from kubeflow_tpu.registry.api import ModelRegistryAPIServer
+
+        payload = _payload(tmp_path, "w.bin", b"api-weights")
+        srv = ModelRegistryAPIServer(store).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        pfx = "/api/model_registry/v1alpha3"
+        try:
+            code, out = self._req(
+                base, "POST", f"{pfx}/registered_models",
+                {"name": "api-model", "description": "via REST"},
+            )
+            assert code == 200 and out["name"] == "api-model"
+            code, out = self._req(
+                base, "POST", f"{pfx}/registered_models/api-model/versions",
+                {"path": payload,
+                 "lineage": [{"kind": "pipeline_run", "ref": "run-42"}]},
+            )
+            assert code == 200 and out["version"] == 1
+            code, out = self._req(
+                base, "POST",
+                f"{pfx}/registered_models/api-model/versions/1:promote",
+                {"stage": "production"},
+            )
+            assert code == 200 and out["stage"] == "production"
+            code, out = self._req(
+                base, "GET", f"{pfx}/registered_models/api-model"
+            )
+            assert out["stages"] == {"production": 1}
+            code, out = self._req(
+                base, "GET",
+                f"{pfx}/registered_models/api-model/versions/1/lineage",
+            )
+            assert out["lineage"][0]["ref"] == "run-42"
+            code, out = self._req(base, "GET", f"{pfx}/registered_models")
+            assert [m["name"] for m in out["registered_models"]] == [
+                "api-model"
+            ]
+            # error contract: unknown → 404, bad request → 400
+            code, _ = self._req(
+                base, "GET", f"{pfx}/registered_models/ghost"
+            )
+            assert code == 404
+            code, _ = self._req(
+                base, "POST", f"{pfx}/registered_models/api-model/versions",
+                {"metadata": {}},
+            )
+            assert code == 400
+            # rollback through the API restores the empty stage
+            code, out = self._req(
+                base, "POST",
+                f"{pfx}/registered_models/api-model/stages/"
+                "production:rollback",
+            )
+            assert code == 200
+            code, out = self._req(
+                base, "GET", f"{pfx}/registered_models/api-model"
+            )
+            assert out["stages"] == {}
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------- dashboard/cli
+
+
+class TestSurfacing:
+    def test_dashboard_models_views(self, store, tmp_path):
+        from kubeflow_tpu.orchestrator.cluster import LocalCluster
+        from kubeflow_tpu.platform.dashboard import DashboardServer
+
+        store.register_version(
+            "m", _payload(tmp_path, "p", b"w"), stage="production",
+            lineage=[("pipeline_run", "r1", {})],
+        )
+        with LocalCluster() as cluster:
+            dash = DashboardServer(cluster, registry=store)
+            rows = dash.models_view()
+            assert rows[0]["name"] == "m" and rows[0]["production"] == 1
+            versions = dash.model_versions_view("m")
+            assert versions[0]["lineage"][0]["ref"] == "r1"
+            assert dash.summary_view()["models"] == 1
+
+    def test_cli_models_round_trip(self, store, tmp_path, capsys):
+        from kubeflow_tpu.cli import main
+
+        root = store.root
+        payload = _payload(tmp_path, "w.bin", b"cli-weights")
+        assert main([
+            "models", "register", "cli-model", "--root", root,
+            "--path", payload, "-p", "accuracy=0.93",
+        ]) == 0
+        assert main([
+            "models", "promote", "cli-model", "--root", root,
+            "--version", "1",
+        ]) == 0
+        assert main(["models", "list", "--root", root]) == 0
+        out = capsys.readouterr().out
+        assert "cli-model" in out and "production=v1" in out
+        assert main([
+            "models", "register", "cli-model", "--root", root,
+            "--path", payload,
+        ]) == 0
+        assert main([
+            "models", "promote", "cli-model", "--root", root,
+            "--version", "2",
+        ]) == 0
+        assert main([
+            "models", "rollback", "cli-model", "--root", root,
+        ]) == 0
+        assert main(["models", "show", "cli-model", "--root", root]) == 0
+        out = capsys.readouterr().out
+        assert "v1\tproduction" in out and "v2\tarchived" in out
+        assert main([
+            "models", "lineage", "cli-model", "--root", root,
+        ]) == 0
+        # errors are exit code 1 with a message, not tracebacks
+        assert main([
+            "models", "promote", "ghost", "--root", root, "--version", "1",
+        ]) == 1
+
+
+# -------------------------------------------------------------- train hook
+
+
+class TestCheckpointHook:
+    def test_train_register_promote_serve_round_trip(self, store, tmp_path):
+        """The full ISSUE round-trip: a training save registers the
+        checkpoint as a version, promotion makes it `@production`, and
+        the serving fetch resolves that exact checkpoint directory."""
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.registry.spec import RegisterOnSave
+        from kubeflow_tpu.train.checkpoint import (
+            CheckpointConfig,
+            Checkpointer,
+        )
+
+        state = {"w": jnp.arange(8, dtype=jnp.float32), "step": jnp.int32(0)}
+        cfg = CheckpointConfig(
+            directory=str(tmp_path / "ckpts"), save_every_steps=1,
+            async_save=False,
+        )
+        with Checkpointer(cfg) as c:
+            assert c.save(
+                1, state,
+                register=RegisterOnSave(
+                    store=store, name="trained", stage="production",
+                    metadata={"experiment": "unit"},
+                ),
+            )
+            mv = c.last_registered
+        assert mv is not None and mv.version == 1
+        assert mv.metadata == {"experiment": "unit", "step": 1}
+        assert store.resolve("trained", "production").sha256 == mv.sha256
+        edges = store.lineage_of("trained", 1)
+        assert edges[0].kind == "checkpoint" and edges[0].ref.endswith("@1")
+        # serve it: the fetched directory carries the checkpoint payload
+        local = storage.download(
+            "registry://trained@production", str(tmp_path / "mnt")
+        )
+        assert os.path.isdir(local)
+        fetched = {
+            f for _, _, fs in os.walk(local) for f in fs
+        }
+        blob = {
+            f for _, _, fs in os.walk(store.blob_path(mv.sha256)) for f in fs
+        }
+        assert fetched == blob and blob
