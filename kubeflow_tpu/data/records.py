@@ -272,6 +272,28 @@ class RecordLoader:
         # consumer holding batch N across next() must not see batch N+1
         return self.spec.unpack(self._buf.copy(), int(n.value))
 
+    def skip(self, n: int) -> "RecordLoader":
+        """Consume ``n`` batches without surfacing them (no unpack, no copy
+        out of the fill buffer) — the ``start_step → iterator`` resume
+        contract for record streams: a factory built as
+        ``lambda s: make_loader(...).skip(s)`` replays the stream to the
+        restored step so batches buffered in a prefetcher at shutdown are
+        regenerated, never lost or double-consumed."""
+        m = ctypes.c_uint64(0)
+        for _ in range(n):
+            ok = self._handle is not None and self._lib.kft_loader_next(
+                self._handle,
+                self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.byref(m),
+            )
+            if not ok:
+                err = self._lib.kft_last_error().decode() if self._handle else ""
+                self.close()
+                if err:
+                    raise OSError(err)
+                break  # stream shorter than the skip: iteration will stop
+        return self
+
     def close(self) -> None:
         if self._handle is not None:
             self._lib.kft_loader_close(self._handle)
@@ -387,6 +409,15 @@ class PyRecordLoader:
 
     def __next__(self):
         return next(self._gen)
+
+    def skip(self, n: int) -> "PyRecordLoader":
+        """Same resume contract as :meth:`RecordLoader.skip`."""
+        for _ in range(n):
+            try:
+                next(self._gen)
+            except StopIteration:
+                break
+        return self
 
     def close(self) -> None:
         pass

@@ -77,13 +77,20 @@ def local_shard_iterator(
     process_index: int | None = None,
     process_count: int | None = None,
     start_step: int = 0,
+    host_cost_ms: float = 0.0,
 ) -> Iterator:
     """Each host draws only its shard of every global batch.
 
     Determinism contract: host p of P takes ``offset=p`` of a batch that is
     globally defined by ``step`` — no host ever materializes the full batch
     (the input-pipeline discipline multi-host TPU training requires).
+
+    ``host_cost_ms`` adds a fixed per-batch host delay emulating real input
+    pipelines (decode/augment cost) — what the ``train_overlap`` microbench
+    uses to make the device-prefetch overlap measurable on synthetic data.
     """
+    import time
+
     import jax
 
     p = jax.process_index() if process_index is None else process_index
@@ -93,5 +100,7 @@ def local_shard_iterator(
     local = global_batch // n
     step = start_step
     while True:
+        if host_cost_ms > 0:
+            time.sleep(host_cost_ms / 1e3)
         yield dataset.batch(local, step=step, offset=p)
         step += 1

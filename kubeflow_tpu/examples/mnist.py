@@ -31,6 +31,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=50)
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--metrics-logdir", type=str, default=None)
+    p.add_argument(
+        "--grad-accum-steps", type=int, default=1,
+        help="in-graph microbatch accumulation (one optimizer update)",
+    )
+    p.add_argument(
+        "--prefetch-depth", type=int, default=2,
+        help="device-prefetch depth; 0 runs the input pipeline inline",
+    )
     args = p.parse_args(argv)
 
     # Rendezvous BEFORE any device access (the torchrun-analog moment).
@@ -77,6 +85,8 @@ def main(argv: list[str] | None = None) -> int:
             ),
             resume=not args.no_resume,
             metrics_logdir=args.metrics_logdir,
+            grad_accum_steps=args.grad_accum_steps,
+            prefetch_depth=args.prefetch_depth,
         ),
     )
     # Factory form: on checkpoint resume the stream continues at the

@@ -242,11 +242,27 @@ class _Timer:
 
 
 class Registry:
-    """Holds metrics; renders the exposition document."""
+    """Holds metrics; renders the exposition document.
+
+    ``add_collector`` registers an on-scrape callback that refreshes gauges
+    from live objects (e.g. a batcher's running stats) right before every
+    exposition — the pull-model analog of client_golang's Collector
+    interface, so instrumented objects never need their own publish loop.
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
+        self._collectors: dict[object, object] = {}
+
+    def add_collector(self, fn, key: object | None = None) -> None:
+        """Call ``fn()`` before each exposition; ``key`` enables removal."""
+        with self._lock:
+            self._collectors[key if key is not None else fn] = fn
+
+    def remove_collector(self, key: object) -> None:
+        with self._lock:
+            self._collectors.pop(key, None)
 
     def _register(self, metric: _Metric) -> _Metric:
         with self._lock:
@@ -282,6 +298,13 @@ class Registry:
         return self._register(Histogram(name, help, labels, buckets))  # type: ignore[return-value]
 
     def expose(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors.values())
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — one bad collector must not
+                pass  # take down the whole /metrics endpoint
         with self._lock:
             metrics = list(self._metrics.values())
         lines: list[str] = []

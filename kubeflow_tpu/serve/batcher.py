@@ -45,6 +45,15 @@ class Batcher:
         self._lock = asyncio.Lock()
         self.stats = {"batches": 0, "instances": 0}
 
+    @property
+    def mean_occupancy(self) -> float:
+        """Mean instances per handler call — how full the MXU batches run.
+
+        Exported (with the raw counters) as gauges on the shared /metrics
+        endpoint, like the engine's pool gauges."""
+        batches = self.stats["batches"]
+        return self.stats["instances"] / batches if batches else 0.0
+
     async def submit(self, instances: list[Any]) -> list[Any]:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         batch: list[tuple[list[Any], asyncio.Future]] | None = None

@@ -29,11 +29,37 @@ from typing import Any
 
 from aiohttp import web
 
+from kubeflow_tpu.obs import prom
 from kubeflow_tpu.serve import protocol
 from kubeflow_tpu.serve.batcher import Batcher, BatcherConfig
 from kubeflow_tpu.serve.engine import EngineOverloaded
 from kubeflow_tpu.serve.logger import RequestLogger
 from kubeflow_tpu.serve.model import Model
+
+#: Batcher occupancy gauges (per model) on the process-wide registry, so the
+#: ObsServer's shared /metrics shows them next to the engine pool gauges;
+#: values refresh at scrape time via a Registry collector per batcher.
+BATCHER_BATCHES = prom.REGISTRY.gauge(
+    "kubeflow_tpu_batcher_batches", "handler calls the batcher has made",
+    ("model",),
+)
+BATCHER_INSTANCES = prom.REGISTRY.gauge(
+    "kubeflow_tpu_batcher_instances", "instances the batcher has coalesced",
+    ("model",),
+)
+BATCHER_MEAN_OCCUPANCY = prom.REGISTRY.gauge(
+    "kubeflow_tpu_batcher_mean_occupancy",
+    "mean instances per handler call (batch fill)", ("model",),
+)
+
+
+def _batcher_collector(name: str, batcher: Batcher):
+    def collect() -> None:
+        BATCHER_BATCHES.labels(model=name).set(batcher.stats["batches"])
+        BATCHER_INSTANCES.labels(model=name).set(batcher.stats["instances"])
+        BATCHER_MEAN_OCCUPANCY.labels(model=name).set(batcher.mean_occupancy)
+
+    return collect
 
 
 class DataPlane:
@@ -61,12 +87,17 @@ class DataPlane:
                 handler=lambda flat, m=model: self._predict_flat(m, flat),
                 config=batcher,
             )
+            prom.REGISTRY.add_collector(
+                _batcher_collector(model.name, self._batchers[model.name]),
+                key=("batcher", model.name),
+            )
 
     def unregister(self, name: str) -> None:
         m = self._models.pop(name, None)
         if m is not None:
             m.unload()
-        self._batchers.pop(name, None)
+        if self._batchers.pop(name, None) is not None:
+            prom.REGISTRY.remove_collector(("batcher", name))
 
     def get(self, name: str) -> Model:
         if name not in self._models:
@@ -423,6 +454,20 @@ class ModelServer:
                 p99 = srt[min(len(srt) - 1, int(len(srt) * 0.99))]
                 lines.append(f'kubeflow_tpu_latency_p50_ms{{model="{name}"}} {p50:.3f}')
                 lines.append(f'kubeflow_tpu_latency_p99_ms{{model="{name}"}} {p99:.3f}')
+        # batcher occupancy gauges, matching the engine's pool gauges
+        for name, b in sorted(self.dataplane._batchers.items()):
+            lines.append(
+                f'kubeflow_tpu_batcher_batches{{model="{name}"}} '
+                f'{b.stats["batches"]}'
+            )
+            lines.append(
+                f'kubeflow_tpu_batcher_instances{{model="{name}"}} '
+                f'{b.stats["instances"]}'
+            )
+            lines.append(
+                f'kubeflow_tpu_batcher_mean_occupancy{{model="{name}"}} '
+                f"{b.mean_occupancy:.3f}"
+            )
         # engine-backed models export their scheduler gauges too
         for name in self.dataplane.list_models():
             model = self.dataplane.get(name)

@@ -39,6 +39,10 @@ class Checkpointer:
             enable_async_checkpointing=config.async_save,
         )
         self._mgr = ocp.CheckpointManager(path, options=options)
+        #: registering saves whose async write is not yet durable:
+        #: [(step, RegisterOnSave)] — ingested on the next interval check
+        #: (any later ``save``) or at ``wait()``/``close()``.
+        self._pending_register: list[tuple[int, Any]] = []
 
     # ------------------------------------------------------------------ #
 
@@ -52,15 +56,35 @@ class Checkpointer:
         ``register`` (a ``registry.spec.RegisterOnSave``) links training
         into the model registry: a step that actually saved is ingested
         as a new ModelVersion with a ``checkpoint`` lineage edge (and
-        optionally promoted to a stage). Registration waits for the
-        async save to be durable first — the registry must never hash a
-        half-written checkpoint. The registered version is exposed as
-        ``self.last_registered``."""
+        optionally promoted to a stage). The registry must never hash a
+        half-written checkpoint, but blocking the hot loop on durability
+        here would defeat ``async_save`` — so for async managers the
+        registration is *deferred*: it runs on a later ``save`` call once
+        the write has completed (a non-blocking probe), or at
+        ``wait()``/``close()`` at the latest. The registered version is
+        exposed as ``self.last_registered``."""
+        self._ingest_ready()  # previous interval's save may be durable now
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
         if saved and register is not None:
+            self._pending_register.append((step, register))
+            if self.config.async_save:
+                self._ingest_ready()  # fast saves may already be durable
+            else:
+                self._ingest_ready(block=True)  # sync save: durable now
+        return saved
+
+    def _ingest_ready(self, block: bool = False) -> None:
+        """Register pending saves whose checkpoint write is durable."""
+        if not self._pending_register:
+            return
+        if block:
             self._mgr.wait_until_finished()
+        elif self._saving_in_progress():
+            return
+        pending, self._pending_register = self._pending_register, []
+        for step, register in pending:
             ckpt = self._step_dir(step)
             self.last_registered = register.store.register_version(
                 register.name,
@@ -74,7 +98,18 @@ class Checkpointer:
                     {"step": int(step)},
                 )],
             )
-        return saved
+
+    def _saving_in_progress(self) -> bool:
+        """Non-blocking durability probe; pessimistic when the installed
+        Orbax can't answer without blocking (registration then waits for
+        the next ``wait()``/``close()`` instead of stalling the loop)."""
+        probe = getattr(self._mgr, "is_saving_in_progress", None)
+        if probe is None:
+            return True
+        try:
+            return bool(probe())
+        except Exception:  # noqa: BLE001 — never break a save over a probe
+            return True
 
     #: the ModelVersion produced by the most recent registering save
     last_registered: Any | None = None
@@ -114,9 +149,11 @@ class Checkpointer:
     def wait(self) -> None:
         """Block until async saves are durable (call before exit)."""
         self._mgr.wait_until_finished()
+        self._ingest_ready(block=True)
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self._ingest_ready(block=True)
         self._mgr.close()
 
     def __enter__(self) -> "Checkpointer":

@@ -50,6 +50,17 @@ class TrainConfig:
     resume: bool = True
     metrics_logdir: str | None = None
     donate_state: bool = True
+    #: in-graph gradient accumulation: the jitted step scans over
+    #: ``grad_accum_steps`` microbatches (one optimizer update, donated
+    #: carry) so ``global_batch`` scales past HBM limits with unchanged
+    #: numerics — losses match accum=1 to fp32 tolerance for equal-size
+    #: microbatches (mean of microbatch means == full-batch mean).
+    grad_accum_steps: int = 1
+    #: device-prefetch depth (train/prefetch.py): how many already-placed
+    #: global batches the background producer keeps ahead of the step
+    #: stream. 0 = fully inline (no thread). Each buffered batch holds
+    #: device memory, so this is an HBM budget knob too.
+    prefetch_depth: int = 2
     #: numerics discipline (SURVEY.md §5.2):
     #: - "metrics"  (default): the MetricWriter raises NonFiniteMetricError
     #:   the first time a logged metric is NaN/inf — zero overhead on the
@@ -69,6 +80,19 @@ class TrainConfig:
             raise ValueError(
                 f"check_numerics={self.check_numerics!r}; expected "
                 "'off', 'metrics', or 'checkify'"
+            )
+        if self.grad_accum_steps < 1:
+            raise ValueError(
+                f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}"
+            )
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                f"prefetch_depth must be >= 0, got {self.prefetch_depth}"
+            )
+        if self.global_batch % self.grad_accum_steps:
+            raise ValueError(
+                f"global batch {self.global_batch} not divisible by "
+                f"grad_accum_steps={self.grad_accum_steps}"
             )
 
 
@@ -176,12 +200,63 @@ class Trainer:
 
     def _build_step(self, state: TrainState):
         loss_fn = self.loss_fn
+        accum = self.config.grad_accum_steps
+        micro_sharding = NamedSharding(self.mesh, P(None, *BATCH_SPEC))
+
+        def grads_of(params, batch, rng):
+            return jax.value_and_grad(loss_fn, has_aux=True)(params, batch, rng)
 
         def step(state: TrainState, batch):
             rng = jax.random.fold_in(state.rng, state.step)
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, batch, rng
-            )
+            if accum == 1:
+                (loss, aux), grads = grads_of(state.params, batch, rng)
+            else:
+                # [B, ...] -> [accum, B/accum, ...]: microbatches stay
+                # sharded over the data axes on their own dim 0, the scan
+                # axis is replicated — one optimizer update at the end, so
+                # numerics match accum=1 (mean of equal-size microbatch
+                # means == full-batch mean) while peak activation memory
+                # drops by ~accum.
+                micro = jax.tree_util.tree_map(
+                    lambda x: jax.lax.with_sharding_constraint(
+                        x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+                        micro_sharding,
+                    ),
+                    batch,
+                )
+                params = state.params
+
+                def body(carry, xs):
+                    g_acc, loss_acc, aux_acc = carry
+                    mb, i = xs
+                    (loss, aux), grads = grads_of(
+                        params, mb, jax.random.fold_in(rng, i)
+                    )
+                    carry = (
+                        jax.tree_util.tree_map(jnp.add, g_acc, grads),
+                        loss_acc + loss,
+                        jax.tree_util.tree_map(jnp.add, aux_acc, aux),
+                    )
+                    return carry, None
+
+                # microbatch 0 seeds the carry (no zeros-tree dtype
+                # guessing); the scan covers 1..accum-1 with donated carry
+                (loss_0, aux_0), g_0 = grads_of(
+                    params,
+                    jax.tree_util.tree_map(lambda x: x[0], micro),
+                    jax.random.fold_in(rng, 0),
+                )
+                (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
+                    body,
+                    (g_0, loss_0, aux_0),
+                    (
+                        jax.tree_util.tree_map(lambda x: x[1:], micro),
+                        jnp.arange(1, accum),
+                    ),
+                )
+                grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
+                loss = loss_sum / accum
+                aux = jax.tree_util.tree_map(lambda a: a / accum, aux_sum)
             new_state = state.apply_gradients(grads=grads)
             metrics = {"loss": loss, **aux}
             return new_state, metrics
@@ -211,7 +286,12 @@ class Trainer:
         )
 
     def global_batch_array(self, local_batch) -> Any:
-        """Process-local numpy batch shards → one global sharded pytree."""
+        """Process-local numpy batch shards → one global sharded pytree.
+
+        Thread-safe: the device prefetcher calls this from its producer
+        thread (explicit NamedSharding, no ambient-mesh dependence), so the
+        H2D copy overlaps the running step.
+        """
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(
                 self.batch_sharding, np.asarray(x)
@@ -219,8 +299,15 @@ class Trainer:
             local_batch,
         )
 
-    def local_batch_size(self) -> int:
-        return self.config.global_batch // jax.process_count()
+    def local_batch_size(self, process_count: int | None = None) -> int:
+        n = jax.process_count() if process_count is None else process_count
+        if self.config.global_batch % n:
+            raise ValueError(
+                f"global batch {self.config.global_batch} not divisible by "
+                f"{n} processes — floor division would silently drop "
+                f"{self.config.global_batch % n} examples per step"
+            )
+        return self.config.global_batch // n
 
     # ------------------------------------------------------------------ #
 
@@ -240,6 +327,10 @@ class Trainer:
         """
         cfg = self.config
         per_device_batch(cfg.global_batch, cfg.mesh)  # validate divisibility
+        # microbatches must also land evenly on the batch partitions, and
+        # the per-process shard must be whole (no silent truncation)
+        per_device_batch(cfg.global_batch // cfg.grad_accum_steps, cfg.mesh)
+        self.local_batch_size()
         if cfg.debug_nans:
             jax.config.update("jax_debug_nans", True)
         own_writer = writer is None
@@ -265,6 +356,14 @@ class Trainer:
             ckpt = Checkpointer(cfg.checkpoint)
             if cfg.resume and ckpt.latest_step() is not None:
                 state = ckpt.restore(state)
+                # Re-home the restored tree into XLA-owned buffers (a
+                # non-donating jitted identity is a sharded copy). Orbax
+                # hands back arrays whose buffers the CPU backend aliases
+                # from host memory; donating those into the first step makes
+                # XLA reuse/free memory it doesn't own — deterministic heap
+                # corruption the moment anything syncs on that step's
+                # outputs (which the metric drain now does every step).
+                state = jax.jit(lambda s: s)(state)
                 start_step = int(jax.device_get(state.step))
                 logger.info("resumed from checkpoint at step %d", start_step)
         if callable(data) and not hasattr(data, "__next__"):
@@ -301,28 +400,80 @@ class Trainer:
         self, state, step_fn, it, ckpt, writer, hooks, history,
         start_step, t_last, last_logged, hb=None,
     ):
+        """The overlapped hot loop (train/prefetch.py):
+
+        - input: a bounded producer thread assembles + places batches
+          ``prefetch_depth`` ahead, so ``next(it)`` + H2D never sit between
+          step dispatches;
+        - output: every step's *device* metrics go to a drain thread that
+          blocks on them there — the loop thread never syncs on the step
+          stream, and the writer's NaN alarm re-raises here via ``poll()``
+          with bounded lag;
+        - timing: the first step is blocked on explicitly (``compile_ms``),
+          and the rate clock re-stamps at its readiness so the first logged
+          ``steps_per_sec`` window measures steady state, not XLA.
+        """
+        from kubeflow_tpu.train.prefetch import MetricsDrain, make_fetcher
+
         cfg = self.config
+        fetcher = make_fetcher(
+            it, self.global_batch_array, depth=cfg.prefetch_depth
+        )
+        drain = MetricsDrain(writer, history=history, hooks=hooks)
+        compile_ms = None
         try:
             for step in range(start_step, cfg.steps):
-                state, metrics = step_fn(state, self.global_batch_array(next(it)))
+                drain.poll()  # bounded-lag NaN alarm / drain-error surface
+                batch = next(fetcher)
+                if compile_ms is None:
+                    # block on step 1 so the compile is measured apart; the
+                    # drain's rate clock starts at this step's readiness, so
+                    # no later steps_per_sec window includes it. Sync via a
+                    # HOST TRANSFER of a metric scalar, not
+                    # block_until_ready: a transfer cannot complete before
+                    # the compute producing it (the bench.py contract), and
+                    # block_until_ready on this jaxlib corrupts the heap
+                    # when the donated state came from an Orbax restore.
+                    t0 = time.perf_counter()
+                    state, metrics = step_fn(state, batch)
+                    np.asarray(jax.tree_util.tree_leaves(metrics)[0])
+                    compile_ms = (time.perf_counter() - t0) * 1e3
+                else:
+                    state, metrics = step_fn(state, batch)
                 if ckpt is not None:
                     ckpt.save(step + 1, state)
-                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                is_log = (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps
+                extra = None
+                if is_log:
                     if hb is not None:
                         # stamp progress; the writer thread owns liveness
                         hb.beat(step + 1)
-                    m = {k: float(v) for k, v in metrics.items()}
                     now = time.perf_counter()
-                    m["steps_per_sec"] = (step + 1 - last_logged) / (now - t_last)
-                    t_last = now
-                    last_logged = step + 1
-                    writer.write(step + 1, m)
-                    history.append({"step": step + 1, **m})
-                    for h in hooks or ():
-                        h(step + 1, m)
+                    # dispatch-side rate (compile-inclusive, like the old
+                    # loop): the drain only falls back to it for the
+                    # degenerate first window where no ready-to-ready
+                    # interval exists yet
+                    elapsed = max(now - t_last, 1e-9)
+                    extra = {
+                        "fallback_steps_per_sec": max(
+                            step + 1 - last_logged, 1
+                        ) / elapsed,
+                        **fetcher.window_stats(),
+                    }
+                    if compile_ms:
+                        # first log boundary: report the compile apart,
+                        # exactly once
+                        extra["compile_ms"] = compile_ms
+                        compile_ms = 0.0
+                    t_last, last_logged = now, step + 1
+                drain.put(step + 1, metrics, log=is_log, extra=extra)
+            drain.close()  # flush; surfaces a pending NaN alarm
         finally:
+            fetcher.close()
+            drain.shutdown()  # idempotent, no-raise (exception paths)
             if ckpt is not None:
                 self._final_save(ckpt, state)
+        drain.poll()
         return state, history
 
     @staticmethod

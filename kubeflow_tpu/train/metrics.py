@@ -18,6 +18,38 @@ from pathlib import Path
 from typing import Any, IO, Mapping
 
 
+#: Overlap instrumentation (train/prefetch.py): the hot-loop split, exported
+#: as process gauges on the shared /metrics endpoint (obs.prom.REGISTRY,
+#: served by ObsServer) and mirrored into every MetricWriter log line.
+#: - data_stall_ms:  mean per-batch wait for the prefetcher this window
+#: - h2d_ms:         mean per-batch host-assembly + H2D placement cost
+#: - device_step_ms: mean device step time (ready-to-ready on the drain)
+#: - compile_ms:     first-step jit compile, reported once — so
+#:   steps_per_sec never conflates compile with steady state
+def _overlap_gauges():
+    from kubeflow_tpu.obs import prom
+
+    return {
+        name: prom.REGISTRY.gauge(f"kubeflow_tpu_train_{name}", help_)
+        for name, help_ in (
+            ("data_stall_ms", "mean ms/batch the loop waited on input data"),
+            ("h2d_ms", "mean ms/batch of host batch assembly + H2D copy"),
+            ("device_step_ms", "mean device step ms (drain ready-to-ready)"),
+            ("compile_ms", "first-step jit compile ms"),
+            ("steps_per_sec", "steady-state training steps per second"),
+        )
+    }
+
+
+def set_overlap_gauges(scalars: Mapping[str, Any]) -> None:
+    """Mirror overlap keys present in ``scalars`` onto the prom gauges."""
+    gauges = _overlap_gauges()
+    for k, g in gauges.items():
+        v = scalars.get(k)
+        if v is not None:
+            g.set(float(v))
+
+
 class NonFiniteMetricError(RuntimeError):
     """A training metric went NaN/inf — fail fast, don't train into noise.
 

@@ -1,0 +1,392 @@
+"""The training hot loop's overlap layer: device prefetch + async metric drain.
+
+The reference stack's throughput comes from exactly two overlaps
+(SURVEY.md §3.1): DataLoader workers assemble batches while the step runs,
+and CUDA-stream async dispatch keeps the device queue full while the Python
+loop races ahead. The SPMD analog here:
+
+- :class:`DevicePrefetcher` — a bounded background producer pulls host
+  batches from the iterator (the native ``data/records.py`` C++ queue or any
+  Python iterator) and performs the H2D placement
+  (``make_array_from_process_local_data`` with the batch sharding) N batches
+  ahead, so host batch assembly and H2D copies fully overlap the running
+  step. The consumer only waits when the producer is behind — that wait is
+  the window's ``data_stall_ms``.
+
+- :class:`MetricsDrain` — the jitted step returns *device* metrics; a
+  background thread blocks on them (``jax.block_until_ready``), so the loop
+  thread never syncs on the step stream. The gap between consecutive ready
+  times IS the device step time (``device_step_ms``). Log-boundary items are
+  converted to floats and fed to the ``MetricWriter`` — whose NaN alarm now
+  fires on this thread and is re-raised on the loop thread at the next
+  ``poll()``/``close()``, i.e. with bounded detection lag instead of a
+  per-window pipeline drain.
+
+Both threads are named ``kft-*`` and joined by ``close()``; a crashed
+producer/drain never deadlocks the loop (sentinels + discard-after-failure).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Mapping
+
+PREFETCH_THREAD_NAME = "kft-prefetch"
+DRAIN_THREAD_NAME = "kft-metrics-drain"
+
+
+class _End:
+    """Producer sentinel: end-of-stream or a carried producer error."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException | None = None):
+        self.error = error
+
+
+class _Fetcher:
+    """Interface shared by the threaded and inline fetchers."""
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def window_stats(self) -> dict[str, float]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def close(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class DevicePrefetcher(_Fetcher):
+    """Bounded background producer: host batches → device-resident arrays.
+
+    ``place`` maps one host batch to its global sharded device form
+    (``Trainer.global_batch_array``). ``depth`` bounds how many *placed*
+    batches may be in flight — placed batches hold device memory, so the
+    bound is an HBM budget, not just a queue size.
+
+    Shutdown contract: ``close()`` is idempotent, unblocks a producer parked
+    on a full queue, joins the thread, and discards any buffered batches.
+    Buffered batches are *consumed from the iterator* — a checkpoint-resuming
+    caller must therefore rebuild the stream from a ``start_step → iterator``
+    factory rather than reuse a partially-drained iterator (see
+    ``Trainer.fit``).
+    """
+
+    def __init__(
+        self,
+        it: Iterator[Any] | Iterable[Any],
+        place: Callable[[Any], Any],
+        *,
+        depth: int = 2,
+    ):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._it = iter(it)
+        self._place = place
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._stall_s = 0.0
+        self._h2d_s = 0.0
+        self._batches = 0
+        self._thread = threading.Thread(
+            target=self._run, name=PREFETCH_THREAD_NAME, daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------ #
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    host = next(self._it)
+                except StopIteration:
+                    self._put(_End())
+                    return
+                t0 = time.perf_counter()
+                placed = self._place(host)
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self._h2d_s += dt
+                if not self._put(placed):
+                    return
+        except BaseException as e:  # noqa: BLE001 — carried to the consumer
+            self._put(_End(e))
+
+    def _put(self, item: Any) -> bool:
+        """Queue.put that never outlives close(): False once stopped."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ------------------------------------------------------------------ #
+
+    def __next__(self) -> Any:
+        t0 = time.perf_counter()
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # no sentinel and no producer: defensive fail-fast
+                    # rather than a silent hang
+                    raise RuntimeError(
+                        "prefetch producer thread died without a sentinel"
+                    )
+        if isinstance(item, _End):
+            self.close()
+            if item.error is not None:
+                raise item.error
+            raise StopIteration
+        with self._lock:
+            self._stall_s += time.perf_counter() - t0
+            self._batches += 1
+        return item
+
+    def window_stats(self) -> dict[str, float]:
+        """Pop the overlap counters accumulated since the last call.
+
+        ``data_stall_ms``/``h2d_ms`` are per-batch means over the window so
+        they read on the same scale as ``device_step_ms``.
+        """
+        with self._lock:
+            stall, h2d, n = self._stall_s, self._h2d_s, self._batches
+            self._stall_s = self._h2d_s = 0.0
+            self._batches = 0
+        scale = 1e3 / max(n, 1)
+        return {"data_stall_ms": stall * scale, "h2d_ms": h2d * scale}
+
+    def close(self) -> None:
+        self._stop.set()
+        # unblock a producer parked on a full queue, drop buffered batches
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+
+
+class InlineFetcher(_Fetcher):
+    """``prefetch_depth=0`` path: same interface, no thread.
+
+    ``next(it)`` + placement run inline on the caller, and their full cost
+    is charged to ``data_stall_ms``/``h2d_ms`` — so the gauges stay honest
+    about what turning prefetch off costs.
+    """
+
+    def __init__(self, it: Iterator[Any] | Iterable[Any], place: Callable[[Any], Any]):
+        self._it = iter(it)
+        self._place = place
+        self._stall_s = 0.0
+        self._h2d_s = 0.0
+        self._batches = 0
+
+    def __next__(self) -> Any:
+        t0 = time.perf_counter()
+        host = next(self._it)
+        t1 = time.perf_counter()
+        placed = self._place(host)
+        self._stall_s += t1 - t0
+        self._h2d_s += time.perf_counter() - t1
+        self._batches += 1
+        return placed
+
+    def window_stats(self) -> dict[str, float]:
+        stall, h2d, n = self._stall_s, self._h2d_s, self._batches
+        self._stall_s = self._h2d_s = 0.0
+        self._batches = 0
+        scale = 1e3 / max(n, 1)
+        return {"data_stall_ms": stall * scale, "h2d_ms": h2d * scale}
+
+    def close(self) -> None:
+        pass
+
+
+def make_fetcher(
+    it: Iterator[Any] | Iterable[Any],
+    place: Callable[[Any], Any],
+    *,
+    depth: int,
+) -> _Fetcher:
+    """Depth 0 → inline; depth >= 1 → threaded device prefetch."""
+    if depth <= 0:
+        return InlineFetcher(it, place)
+    return DevicePrefetcher(it, place, depth=depth)
+
+
+# --------------------------------------------------------------------- #
+# metric drain
+# --------------------------------------------------------------------- #
+
+_STOP = object()
+
+
+class MetricsDrain:
+    """Asynchronous consumer of per-step device metrics.
+
+    The loop thread hands over every step's device-metric pytree via
+    :meth:`put` (a bounded, non-syncing enqueue) and never reads device
+    values itself. This thread blocks until each step's metrics are ready;
+    log-boundary items are additionally converted to scalars and written.
+
+    Error contract: any exception here (``NonFiniteMetricError`` from the
+    writer's NaN alarm above all) is stored, the thread keeps *draining and
+    discarding* so the loop can never deadlock on a full queue, and the
+    error is re-raised on the loop thread at the next :meth:`poll` or
+    :meth:`close` — detection lag is bounded by the queue depth.
+    """
+
+    def __init__(
+        self,
+        writer,
+        *,
+        history: list[dict],
+        hooks=(),
+        depth: int = 64,
+    ):
+        from kubeflow_tpu.train.metrics import set_overlap_gauges, _to_scalar
+
+        self._to_scalar = _to_scalar
+        self._set_gauges = set_overlap_gauges
+        self._writer = writer
+        self._history = history
+        self._hooks = tuple(hooks or ())
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._error: BaseException | None = None
+        self._raised = False
+        self._last_ready: float | None = None
+        self._win_step_s = 0.0
+        self._win_steps = 0
+        self._t_logged: float | None = None
+        self._step_logged: int | None = None
+        self._thread = threading.Thread(
+            target=self._run, name=DRAIN_THREAD_NAME, daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------ #
+
+    def put(
+        self,
+        step: int,
+        metrics: Mapping[str, Any],
+        *,
+        log: bool,
+        extra: Mapping[str, float] | None = None,
+    ) -> None:
+        """Enqueue one step's device metrics; throttles (never deadlocks)."""
+        item = (step, metrics, log, dict(extra or ()))
+        while True:
+            try:
+                self._q.put(item, timeout=0.5)
+                return
+            except queue.Full:
+                if not self._thread.is_alive():
+                    return  # poll()/close() will surface whatever killed it
+
+    def poll(self) -> None:
+        """Re-raise a drain-side error on the caller (bounded-lag alarm)."""
+        if self._error is not None and not self._raised:
+            self._raised = True
+            raise self._error
+
+    def close(self) -> None:
+        """Flush + join, then surface any pending drain error."""
+        self.shutdown()
+        self.poll()
+
+    def shutdown(self) -> None:
+        """Idempotent no-raise join (exception-path cleanup)."""
+        if self._thread.is_alive():
+            while True:
+                try:
+                    self._q.put(_STOP, timeout=0.5)
+                    break
+                except queue.Full:
+                    if not self._thread.is_alive():
+                        break
+            self._thread.join(timeout=30.0)
+
+    # ------------------------------------------------------------------ #
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            if self._error is not None:
+                continue  # drain-and-discard: the loop must never block
+            try:
+                self._process(*item)
+            except BaseException as e:  # noqa: BLE001 — re-raised via poll()
+                self._error = e
+
+    def _process(self, step, metrics, log, extra) -> None:
+        import jax
+        import numpy as np
+
+        leaves = jax.tree_util.tree_leaves(metrics)
+        if leaves:
+            # sync via a HOST TRANSFER of one metric scalar, never
+            # block_until_ready: a transfer cannot complete before the
+            # compute producing it (bench.py's honest-timing contract), and
+            # block_until_ready here corrupts the heap on this jaxlib when
+            # the step's donated state came from an Orbax restore
+            np.asarray(leaves[0])
+        now = time.perf_counter()
+        if self._last_ready is not None:
+            self._win_step_s += now - self._last_ready
+            self._win_steps += 1
+        self._last_ready = now
+        if self._t_logged is None:
+            # first step's readiness re-stamps the rate clock: compile time
+            # never pollutes steps_per_sec (it's reported as compile_ms)
+            self._t_logged = now
+            self._step_logged = step
+        if not log:
+            return
+        m = {k: self._to_scalar(v) for k, v in metrics.items()}
+        steps = step - self._step_logged
+        elapsed = now - self._t_logged
+        if steps > 0 and elapsed > 0:
+            m["steps_per_sec"] = steps / elapsed
+        else:
+            # degenerate window (the first step is itself a log boundary):
+            # the loop's dispatch-side estimate is the only clock available
+            m["steps_per_sec"] = float(extra.pop("fallback_steps_per_sec", 0.0))
+        if self._win_steps:
+            m["device_step_ms"] = self._win_step_s / self._win_steps * 1e3
+        self._win_step_s = 0.0
+        self._win_steps = 0
+        self._t_logged = now
+        self._step_logged = step
+        extra.pop("fallback_steps_per_sec", None)
+        m.update(extra)
+        self._set_gauges(m)
+        self._writer.write(step, m)
+        self._history.append({"step": step, **m})
+        for h in self._hooks:
+            h(step, m)
+
+
+def live_kft_threads() -> list[str]:
+    """Names of still-alive overlap threads — the leak check smoke.sh runs."""
+    return [
+        t.name
+        for t in threading.enumerate()
+        if t.name in (PREFETCH_THREAD_NAME, DRAIN_THREAD_NAME) and t.is_alive()
+    ]

@@ -1,0 +1,164 @@
+"""train/prefetch.py: producer/drain thread semantics.
+
+These are host-side contracts (ordering, bounded depth, error carry,
+clean joins) — the trainer-integration side lives in tests/test_train.py.
+"""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.train.metrics import MetricWriter, NonFiniteMetricError
+from kubeflow_tpu.train.prefetch import (
+    DevicePrefetcher,
+    InlineFetcher,
+    MetricsDrain,
+    live_kft_threads,
+    make_fetcher,
+)
+
+
+def test_prefetcher_preserves_order_and_stops():
+    pf = DevicePrefetcher(range(5), lambda x: x * 10, depth=2)
+    assert list(pf) == [0, 10, 20, 30, 40]
+    assert live_kft_threads() == []  # StopIteration closed + joined
+
+
+def test_prefetcher_bounded_depth():
+    produced = []
+    done = threading.Event()
+
+    def source():
+        for i in range(100):
+            produced.append(i)
+            yield i
+        done.set()
+
+    pf = DevicePrefetcher(source(), lambda x: x, depth=3)
+    time.sleep(0.3)  # let the producer run as far ahead as it can
+    # nothing consumed: at most depth queued + 1 in flight in place()
+    assert len(produced) <= 3 + 1
+    assert not done.is_set()
+    consumed = [next(pf) for _ in range(10)]
+    assert consumed == list(range(10))
+    pf.close()
+    assert live_kft_threads() == []
+
+
+def test_prefetcher_carries_producer_error():
+    def source():
+        yield 1
+        raise ValueError("bad shard")
+
+    pf = DevicePrefetcher(source(), lambda x: x, depth=2)
+    assert next(pf) == 1
+    with pytest.raises(ValueError, match="bad shard"):
+        next(pf)
+    assert live_kft_threads() == []
+
+
+def test_prefetcher_place_error_propagates():
+    def place(x):
+        if x == 2:
+            raise RuntimeError("H2D failed")
+        return x
+
+    pf = DevicePrefetcher(range(5), place, depth=2)
+    assert next(pf) == 0
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="H2D failed"):
+        next(pf)
+    assert live_kft_threads() == []
+
+
+def test_prefetcher_close_unblocks_parked_producer():
+    def forever():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    pf = DevicePrefetcher(forever(), lambda x: x, depth=1)
+    assert next(pf) == 0
+    pf.close()  # producer is parked on a full queue right now
+    pf.close()  # idempotent
+    assert live_kft_threads() == []
+
+
+def test_window_stats_reset_between_windows():
+    pf = DevicePrefetcher(range(6), lambda x: x, depth=2)
+    for _ in range(3):
+        next(pf)
+    w1 = pf.window_stats()
+    assert set(w1) == {"data_stall_ms", "h2d_ms"}
+    assert w1["data_stall_ms"] >= 0 and w1["h2d_ms"] >= 0
+    w2 = pf.window_stats()  # nothing consumed since: zeros
+    assert w2["data_stall_ms"] == 0 and w2["h2d_ms"] == 0
+    pf.close()
+
+
+def test_inline_fetcher_same_interface():
+    f = make_fetcher(range(3), lambda x: x + 1, depth=0)
+    assert isinstance(f, InlineFetcher)
+    assert [next(f), next(f), next(f)] == [1, 2, 3]
+    stats = f.window_stats()
+    assert set(stats) == {"data_stall_ms", "h2d_ms"}
+    with pytest.raises(StopIteration):
+        next(f)
+    f.close()
+
+
+def test_drain_writes_logged_windows_in_order():
+    out = io.StringIO()
+    history: list[dict] = []
+    hooked: list[int] = []
+    with MetricWriter(None, stdout=out) as w:
+        drain = MetricsDrain(
+            w, history=history, hooks=[lambda s, m: hooked.append(s)]
+        )
+        for step in range(1, 7):
+            drain.put(
+                step,
+                {"loss": np.float32(step)},
+                log=step % 2 == 0,
+                extra={"data_stall_ms": 1.0} if step % 2 == 0 else None,
+            )
+        drain.close()
+    assert [h["step"] for h in history] == [2, 4, 6]
+    assert [h["loss"] for h in history] == [2.0, 4.0, 6.0]
+    assert all("data_stall_ms" in h for h in history)
+    assert hooked == [2, 4, 6]
+    assert "step=2 loss=2" in out.getvalue()
+
+
+def test_drain_nan_alarm_bounded_lag_no_deadlock():
+    w = MetricWriter(None, stdout=io.StringIO(), nan_alarm=True)
+    drain = MetricsDrain(w, history=[], depth=4)
+    drain.put(1, {"loss": np.float32("nan")}, log=True)
+    # the failed drain must keep discarding, never deadlock the producer
+    for step in range(2, 40):
+        drain.put(step, {"loss": np.float32(step)}, log=True)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            drain.poll()
+        except NonFiniteMetricError:
+            break
+        time.sleep(0.01)
+    else:
+        pytest.fail("NaN alarm never surfaced via poll()")
+    drain.shutdown()  # no-raise path after the error was surfaced
+    assert live_kft_threads() == []
+
+
+def test_drain_close_surfaces_pending_error_once():
+    w = MetricWriter(None, stdout=io.StringIO(), nan_alarm=True)
+    drain = MetricsDrain(w, history=[])
+    drain.put(3, {"loss": np.float32("inf")}, log=True)
+    with pytest.raises(NonFiniteMetricError, match="step 3"):
+        drain.close()
+    drain.shutdown()  # already raised: must not raise again
+    drain.poll()

@@ -150,6 +150,28 @@ def test_batches_survive_next_call(tmp_path, built):
         np.testing.assert_array_equal(first, snapshot)
 
 
+def test_skip_matches_manual_iteration_both_loaders(tmp_path, built):
+    """skip(n) — the start_step→iterator resume contract for record
+    streams — must land exactly where n next() calls land, natively and in
+    the fallback, and degrade to StopIteration past the end."""
+    files, _ = _write_files(tmp_path)
+
+    def after_skip(cls, n):
+        loader = cls(files, SPEC, batch_size=8, shuffle_records=0)
+        return loader.skip(n).__next__()["idx"].tolist()
+
+    def after_iter(cls, n):
+        loader = cls(files, SPEC, batch_size=8, shuffle_records=0)
+        for _ in range(n):
+            next(loader)
+        return next(loader)["idx"].tolist()
+
+    for cls in (RecordLoader, PyRecordLoader):
+        assert after_skip(cls, 3) == after_iter(cls, 3)
+        with pytest.raises(StopIteration):
+            next(cls(files, SPEC, batch_size=8).skip(10_000))
+
+
 def test_python_fallback_rejects_spec_mismatch(tmp_path, built):
     files, _ = _write_files(tmp_path, per_file=(8,))
     wrong = RecordSpec.of(image=("float32", (2, 2)), label=("int32", ()))

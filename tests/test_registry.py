@@ -552,3 +552,42 @@ class TestCheckpointHook:
             f for _, _, fs in os.walk(store.blob_path(mv.sha256)) for f in fs
         }
         assert fetched == blob and blob
+
+    def test_async_save_defers_registration_off_the_hot_loop(
+        self, store, tmp_path
+    ):
+        """A registering save with ``async_save=True`` must not block the
+        loop on durability: registration happens on a later interval check
+        or at wait()/close() — and the registered version still hashes the
+        fully-written checkpoint."""
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.registry.spec import RegisterOnSave
+        from kubeflow_tpu.train.checkpoint import (
+            CheckpointConfig,
+            Checkpointer,
+        )
+
+        reg = RegisterOnSave(store=store, name="async-trained")
+        cfg = CheckpointConfig(
+            directory=str(tmp_path / "ackpts"), save_every_steps=1,
+            async_save=True,
+        )
+        with Checkpointer(cfg) as c:
+            state = {"w": jnp.arange(4, dtype=jnp.float32)}
+            assert c.save(1, state, register=reg)
+            # save() returned without a mandatory wait_until_finished();
+            # a later interval check or close() performs the ingestion
+            c.wait()
+            assert c.last_registered is not None
+            assert c.last_registered.version == 1
+        assert store.resolve("async-trained", "1").metadata["step"] == 1
+        # two registering saves across intervals both land, in order
+        cfg2 = CheckpointConfig(
+            directory=str(tmp_path / "bckpts"), save_every_steps=1,
+            async_save=True,
+        )
+        with Checkpointer(cfg2) as c2:
+            c2.save(1, {"w": jnp.zeros(2)}, register=reg)
+            c2.save(2, {"w": jnp.ones(2)}, register=reg)
+        assert store.resolve("async-trained", "3").metadata["step"] == 2

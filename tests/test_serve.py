@@ -271,6 +271,40 @@ def test_model_server_batching_path():
     assert b.stats["instances"] == 2
 
 
+def test_batcher_stats_exported_as_gauges():
+    """Batcher occupancy rides both /metrics surfaces: the ModelServer's
+    own endpoint and the shared prom registry (ObsServer), like the
+    engine's pool gauges."""
+    server = ModelServer([_Doubler("dbl")],
+                         batcher=BatcherConfig(max_batch_size=4, max_latency_ms=5))
+
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async with TestClient(TestServer(server.build_app())) as client:
+            await asyncio.gather(
+                client.post("/v1/models/dbl:predict", json={"instances": [[1]]}),
+                client.post("/v1/models/dbl:predict",
+                            json={"instances": [[2], [3]]}),
+            )
+            r = await client.get("/metrics")
+            return await r.text()
+
+    text = asyncio.run(run())
+    assert 'kubeflow_tpu_batcher_instances{model="dbl"} 3' in text
+    assert 'kubeflow_tpu_batcher_batches{model="dbl"}' in text
+    assert 'kubeflow_tpu_batcher_mean_occupancy{model="dbl"}' in text
+    # shared registry: the collector refreshes values at scrape time
+    from kubeflow_tpu.obs.prom import REGISTRY
+
+    exposed = REGISTRY.expose()
+    assert 'kubeflow_tpu_batcher_instances{model="dbl"} 3' in exposed
+    assert "# TYPE kubeflow_tpu_batcher_mean_occupancy gauge" in exposed
+    # unregister tears the collector down with the batcher
+    server.dataplane.unregister("dbl")
+    assert ("batcher", "dbl") not in REGISTRY._collectors
+
+
 def test_http_client_errors_are_400_not_500():
     from aiohttp.test_utils import TestClient, TestServer
 

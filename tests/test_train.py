@@ -116,6 +116,113 @@ def test_trainer_checkpoint_resume(tmp_path, devices8):
     assert not np.array_equal(np.asarray(p1), np.asarray(p2))
 
 
+def test_local_batch_size_divisibility_raises_not_truncates():
+    t = _mnist_trainer()
+    assert t.local_batch_size(process_count=2) == 16
+    with pytest.raises(ValueError, match="not divisible"):
+        t.local_batch_size(process_count=3)
+    # config-level: a global batch that doesn't split into microbatches
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        _mnist_trainer(grad_accum_steps=5)
+
+
+def test_grad_accum_numerics_parity(devices8):
+    """accum=4 must match accum=1 losses to fp32 tolerance at the same
+    effective global batch (one optimizer update per step either way)."""
+    histories = []
+    for accum in (1, 4):
+        trainer = _mnist_trainer(steps=6, grad_accum_steps=accum)
+        data = local_shard_iterator(ClassPrototypeDataset(), 32)
+        state, history = trainer.fit(data)
+        assert int(state.step) == 6
+        histories.append([h["loss"] for h in history])
+    np.testing.assert_allclose(histories[0], histories[1], rtol=2e-5, atol=1e-6)
+
+
+def test_prefetch_on_off_identical_per_step_metrics(devices8):
+    """The overlap layer must not change the math: same batches, same
+    order, same per-step losses whether placement is inline or threaded."""
+    runs = []
+    for depth in (0, 3):
+        trainer = _mnist_trainer(steps=8, prefetch_depth=depth)
+        _, history = trainer.fit(local_shard_iterator(ClassPrototypeDataset(), 32))
+        runs.append(history)
+    for h0, h3 in zip(runs[0], runs[1]):
+        assert h0["step"] == h3["step"]
+        np.testing.assert_array_equal(h0["loss"], h3["loss"])
+        np.testing.assert_array_equal(h0["accuracy"], h3["accuracy"])
+
+
+def test_overlap_gauges_in_writer_output_and_prom(devices8):
+    out = io.StringIO()
+    trainer = _mnist_trainer(steps=4)
+    with MetricWriter(None, stdout=out) as w:
+        trainer.fit(local_shard_iterator(ClassPrototypeDataset(), 32), writer=w)
+    parsed = parse_stdout_metrics(out.getvalue())
+    assert parsed, out.getvalue()
+    first, last = parsed[0], parsed[-1]
+    # the split instrumentation rides every logged window...
+    for key in ("data_stall_ms", "h2d_ms", "device_step_ms", "steps_per_sec"):
+        assert key in last, (key, last)
+    # ...and compile time is its own metric, reported exactly once
+    assert "compile_ms" in first and first["compile_ms"] > 0
+    assert "compile_ms" not in last
+    # mirrored onto the process-wide prom registry (the shared /metrics)
+    from kubeflow_tpu.obs.prom import REGISTRY
+
+    text = REGISTRY.expose()
+    for name in (
+        "kubeflow_tpu_train_data_stall_ms",
+        "kubeflow_tpu_train_device_step_ms",
+        "kubeflow_tpu_train_compile_ms",
+    ):
+        assert name in text
+
+
+def test_fit_joins_overlap_threads(devices8):
+    from kubeflow_tpu.train.prefetch import live_kft_threads
+
+    trainer = _mnist_trainer(steps=4, prefetch_depth=2)
+    trainer.fit(local_shard_iterator(ClassPrototypeDataset(), 32))
+    assert live_kft_threads() == []
+
+
+def test_resume_with_prefetch_neither_loses_nor_replays_batches(
+    tmp_path, devices8
+):
+    """Batches buffered in the prefetcher when the first run exits must be
+    regenerated by the resume factory, not lost or double-trained: a
+    checkpointed 4+4 run must land bit-for-bit where an unbroken 8-step run
+    lands (state includes the optimizer, so any drift means the stream
+    skipped or replayed)."""
+    requested_starts: list[int] = []
+
+    def factory(start_step):
+        requested_starts.append(start_step)
+        return local_shard_iterator(
+            ClassPrototypeDataset(), 32, start_step=start_step
+        )
+
+    ckpt = CheckpointConfig(
+        directory=str(tmp_path / "ckpt"), save_every_steps=2, async_save=False
+    )
+    t1 = _mnist_trainer(steps=4, checkpoint=ckpt, prefetch_depth=3)
+    t1.fit(factory)
+    t2 = _mnist_trainer(steps=8, checkpoint=ckpt, prefetch_depth=3)
+    resumed, _ = t2.fit(factory)
+    assert requested_starts == [0, 4]
+
+    t3 = _mnist_trainer(steps=8, prefetch_depth=3)
+    unbroken, _ = t3.fit(factory)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(resumed.params),
+        jax.tree_util.tree_leaves(unbroken.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+
+
 def test_checkpointer_restore_to_different_mesh(tmp_path, devices8):
     """Elastic-restart core property: save on mesh A, restore on mesh B."""
     from jax.sharding import NamedSharding, PartitionSpec as P
