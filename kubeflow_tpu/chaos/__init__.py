@@ -1,0 +1,26 @@
+"""Chaos harness: deterministic, seedable fault injection for the platform.
+
+"Recovery paths that are never executed are broken paths" — this package
+makes every failure the platform claims to survive an injectable, tested
+input: declarative :class:`FaultPlan`s (``plan``), seam-level injectors
+(``injectors``: process kill/preempt/wedge, slice loss, checkpoint and
+storage corruption), and a step-triggered :class:`ChaosRunner` that drives
+a plan against a job on a ``LocalCluster`` while measuring recovery
+(``kft_chaos_injected_total``, ``kft_recovery_seconds``).
+"""
+
+from kubeflow_tpu.chaos.injectors import (  # noqa: F401
+    corrupt_checkpoint,
+    record_injection,
+    storage_faults,
+)
+from kubeflow_tpu.chaos.plan import (  # noqa: F401
+    CorruptCheckpoint,
+    CrashWorker,
+    DropSlice,
+    Fault,
+    FaultPlan,
+    PreemptWorker,
+    WedgeWorker,
+)
+from kubeflow_tpu.chaos.runner import ChaosRunner  # noqa: F401

@@ -1,0 +1,193 @@
+"""Fault injectors: the hands of the chaos harness.
+
+Each injector drives an *existing* platform seam — ``ProcessLauncher.kill``
+for process faults, ``Fleet.remove_slice`` for capacity faults, the
+checkpoint directory for integrity faults, and the ``serve.storage`` fetcher
+registry for transfer faults — so production code carries no chaos branches;
+what the harness exercises is exactly what production runs.
+
+Every injection increments ``kft_chaos_injected_total{kind=...}`` on the
+shared registry; the runner additionally observes ``kft_recovery_seconds``
+once the platform has demonstrably recovered from a disruptive fault.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import random
+import time
+from pathlib import Path
+
+from kubeflow_tpu.obs import prom
+
+logger = logging.getLogger(__name__)
+
+CHAOS_INJECTED = prom.REGISTRY.counter(
+    "kft_chaos_injected_total",
+    "faults injected by the chaos harness",
+    labels=("kind",),
+)
+RECOVERY_SECONDS = prom.REGISTRY.histogram(
+    "kft_recovery_seconds",
+    "wall time from a disruptive fault to demonstrated recovery "
+    "(progress past the pre-fault step, or a terminal Succeeded)",
+)
+
+
+def record_injection(kind: str) -> None:
+    CHAOS_INJECTED.labels(kind=kind).inc()
+
+
+# --------------------------------------------------------------------- #
+# checkpoint corruption
+# --------------------------------------------------------------------- #
+
+_MANIFEST = "_KFT_MANIFEST.json"
+
+
+def corrupt_checkpoint(
+    directory: str | os.PathLike,
+    step: int | None = None,
+    *,
+    rng: random.Random | None = None,
+) -> tuple[int, str]:
+    """Flip one byte of one data file in a checkpoint step (the newest when
+    ``step`` is None), leaving the sha256 manifest untouched — exactly the
+    silent corruption ``Checkpointer.verify_step`` must catch. Returns
+    ``(step, path_of_corrupted_file)``. Deterministic under ``rng``."""
+    rng = rng or random.Random(0)
+    base = Path(directory).absolute()
+    steps: dict[int, Path] = {}
+    for cand in base.iterdir() if base.exists() else []:
+        digits = "".join(ch for ch in cand.name if ch.isdigit())
+        if cand.is_dir() and digits:
+            steps[int(digits)] = cand
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {base}")
+    chosen = max(steps) if step is None else int(step)
+    if chosen not in steps:
+        raise FileNotFoundError(f"no checkpoint step {chosen} under {base}")
+    files = sorted(
+        (
+            p for p in steps[chosen].rglob("*")
+            if p.is_file() and not p.name.startswith(_MANIFEST)
+        ),
+        key=lambda p: (-p.stat().st_size, str(p)),
+    )
+    if not files:
+        raise FileNotFoundError(f"checkpoint step {chosen} has no files")
+    # the biggest file is the tensor payload — the interesting victim
+    victim = files[0]
+    data = bytearray(victim.read_bytes())
+    if not data:
+        raise OSError(f"{victim} is empty; nothing to corrupt")
+    i = rng.randrange(len(data))
+    data[i] ^= 0xFF
+    victim.write_bytes(bytes(data))
+    record_injection("corrupt_checkpoint")
+    logger.warning(
+        "chaos: flipped byte %d of %s (checkpoint step %d)", i, victim, chosen
+    )
+    return chosen, str(victim)
+
+
+# --------------------------------------------------------------------- #
+# storage / transfer faults
+# --------------------------------------------------------------------- #
+
+
+def _corrupt_path(path: str, rng: random.Random) -> None:
+    """Flip one byte of a fetched artifact (file, or the largest file of a
+    directory) — a silently-torn transfer."""
+    p = Path(path)
+    if p.is_dir():
+        files = sorted(
+            (f for f in p.rglob("*") if f.is_file()),
+            key=lambda f: (-f.stat().st_size, str(f)),
+        )
+        if not files:
+            return
+        p = files[0]
+    data = bytearray(p.read_bytes())
+    if not data:
+        return
+    data[rng.randrange(len(data))] ^= 0xFF
+    p.write_bytes(bytes(data))
+
+
+@contextlib.contextmanager
+def storage_faults(
+    *,
+    fail: int = 0,
+    error: Exception | None = None,
+    delay_s: float = 0.0,
+    corrupt_every: int = 0,
+    seed: int = 0,
+):
+    """Wrap every registered ``serve.storage`` fetcher (and the local
+    ``file://`` path) for the duration of the ``with`` block:
+
+    - ``fail``: the first N fetch calls raise ``error`` (default a
+      transient ``OSError``) — exercises retry/backoff;
+    - ``delay_s``: every call is slowed by this much first — exercises
+      timeout budgets without needing a slow backend;
+    - ``corrupt_every``: every Nth successful fetch has one byte of its
+      staged output flipped before the checksum step — exercises the
+      verify/``expected_sha256`` rejection path.
+
+    Yields a stats dict (``calls``/``failed``/``corrupted``). Restores the
+    registry exactly on exit; reentrant use is not supported.
+    """
+    from kubeflow_tpu.serve import storage
+
+    # force the lazily self-registering fetchers in BEFORE snapshotting, so
+    # registry:// and the cloud schemes are wrapped too (download() would
+    # otherwise import them mid-block, unwrapped)
+    for mod in ("kubeflow_tpu.registry.fetcher",
+                "kubeflow_tpu.serve.cloudstorage"):
+        try:
+            __import__(mod)
+        except Exception:  # noqa: BLE001 — a missing optional stays missing
+            pass
+
+    rng = random.Random(seed)
+    err = error if error is not None else OSError(
+        "chaos: injected transient storage failure"
+    )
+    stats = {"calls": 0, "failed": 0, "corrupted": 0}
+
+    def wrap(fn):
+        def faulty(uri_or_rest, staging):
+            stats["calls"] += 1
+            if delay_s:
+                record_injection("storage_delay")
+                time.sleep(delay_s)
+            if stats["failed"] < fail:
+                stats["failed"] += 1
+                record_injection("storage_fail")
+                raise err
+            out = fn(uri_or_rest, staging)
+            if corrupt_every and (
+                (stats["calls"] - stats["failed"]) % corrupt_every == 0
+            ):
+                stats["corrupted"] += 1
+                record_injection("storage_corrupt")
+                _corrupt_path(out, rng)
+            return out
+
+        return faulty
+
+    saved_fetchers = dict(storage._FETCHERS)
+    saved_file = storage._fetch_file
+    storage._FETCHERS.update(
+        {scheme: wrap(fn) for scheme, fn in saved_fetchers.items()}
+    )
+    storage._fetch_file = wrap(saved_file)
+    try:
+        yield stats
+    finally:
+        storage._FETCHERS.clear()
+        storage._FETCHERS.update(saved_fetchers)
+        storage._fetch_file = saved_file

@@ -1,0 +1,144 @@
+"""Declarative fault plans: failure as a first-class, testable input.
+
+Kubeflow's TrainJob/JobSet failure-policy work and Orbax's emergency
+checkpointing both argue the same point (PAPERS.md): a recovery path that is
+never executed is a broken path. A :class:`FaultPlan` names, up front and
+deterministically, every failure a run must survive — which worker dies at
+which trainer step with which signal, when the preemption notice arrives
+and how much grace it carries, which slice evaporates, which checkpoint
+gets silently corrupted — and the chaos runner
+(:mod:`kubeflow_tpu.chaos.runner`) injects them through the platform's own
+seams (``ProcessLauncher.kill``, ``Fleet.remove_slice``, the checkpoint
+directory). Determinism contract: triggers key off *observed trainer
+steps* (heartbeat stamps / stdout metrics), never wall-clock time, and any
+random choice (victim byte, victim worker) draws from ``seed``.
+
+Plans serialize (``to_dict``/``from_dict``) so ``kft chaos run`` can take
+them from YAML/JSON alongside the job manifest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal as _signal
+from typing import Any, Mapping
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """Base trigger condition shared by every fault kind.
+
+    ``at_step``: fire once the observed trainer step is >= this (None =
+    fire as soon as the target is Running). ``on_attempt``: only consider
+    firing while the target worker is on this attempt (so a plan can
+    schedule distinct faults across restarts without double-firing).
+    """
+
+    at_step: int | None = None
+    on_attempt: int = 0
+
+    @property
+    def kind(self) -> str:
+        return type(self).__name__
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["kind"] = self.kind
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashWorker(Fault):
+    """Kill one gang member with ``sig`` — the launcher records exit
+    128+sig, which ``RestartPolicy.EXIT_CODE`` treats as retryable infra."""
+
+    replica_type: str = "worker"
+    index: int = 0
+    sig: int = int(_signal.SIGKILL)
+
+
+@dataclasses.dataclass(frozen=True)
+class PreemptWorker(Fault):
+    """Deliver a preemption notice: SIGTERM now; if the target is still
+    alive after ``grace_s`` (checked on subsequent runner passes), SIGKILL
+    — the node-drain / spot-reclaim contract. ``index=None`` preempts the
+    whole replica group (a slice being reclaimed takes every process on
+    it)."""
+
+    replica_type: str = "worker"
+    index: int | None = None
+    grace_s: float = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
+class WedgeWorker(Fault):
+    """SIGSTOP the target: alive but frozen — heartbeats stop without an
+    exit, the exact blind spot the ``HeartbeatSupervisor`` exists for.
+    The supervisor's SIGKILL works on a stopped process."""
+
+    replica_type: str = "worker"
+    index: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DropSlice(Fault):
+    """Remove a slice from the fleet mid-run (preemption/maintenance).
+    ``slice_id=None`` drops the slice hosting the targeted worker. The
+    reconciler requeues the gang (reason ``SliceLost``) until capacity
+    returns."""
+
+    slice_id: str | None = None
+    replica_type: str = "worker"
+    index: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CorruptCheckpoint(Fault):
+    """Silently flip one byte in the newest checkpoint step under
+    ``directory`` (or an explicit ``step``) — the bit-rot/torn-copy case
+    the sha256 manifest exists to catch: ``restore`` must walk back, not
+    die and not load garbage."""
+
+    directory: str = ""
+    step: int | None = None
+
+
+FAULT_KINDS = {
+    c.__name__: c
+    for c in (CrashWorker, PreemptWorker, WedgeWorker, DropSlice,
+              CorruptCheckpoint)
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An ordered, seedable set of faults for one job run."""
+
+    faults: tuple[Fault, ...] = ()
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        for f in self.faults:
+            if not isinstance(f, Fault):
+                raise TypeError(f"not a Fault: {f!r}")
+        object.__setattr__(self, "faults", tuple(self.faults))
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "FaultPlan":
+        faults = []
+        for fd in d.get("faults", []):
+            fd = dict(fd)
+            kind = fd.pop("kind", None)
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}; expected one of "
+                    f"{sorted(FAULT_KINDS)}"
+                )
+            faults.append(FAULT_KINDS[kind](**fd))
+        return cls(faults=tuple(faults), seed=int(d.get("seed", 0)))
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "faults": [f.to_dict() for f in self.faults],
+        }

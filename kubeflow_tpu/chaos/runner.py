@@ -1,0 +1,265 @@
+"""ChaosRunner: drives a FaultPlan against a job on a LocalCluster.
+
+The runner is a deterministic observer/actuator pair: each ``poll()`` pass
+reads *observed trainer progress* (heartbeat step stamps the drain writes
+per completed step, falling back to stdout ``step=N`` metrics), decides
+which faults have reached their trigger, and fires them through the
+platform seams. No fault fires on wall-clock time — the only clock in the
+trigger logic is the trainer's own step counter — so a plan replays
+identically across machines and speeds.
+
+Recovery observability: every disruptive fault notes the pre-fault step;
+once the job demonstrates recovery (progress past that step on a later
+attempt, or a terminal Succeeded), the elapsed wall time lands in
+``kft_recovery_seconds`` and the fault's report entry gains
+``recovered_after_s``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import signal as _signal
+import time
+from typing import Any
+
+from kubeflow_tpu.chaos import injectors
+from kubeflow_tpu.chaos.plan import (
+    CorruptCheckpoint,
+    CrashWorker,
+    DropSlice,
+    Fault,
+    FaultPlan,
+    PreemptWorker,
+    WedgeWorker,
+)
+from kubeflow_tpu.obs import heartbeat as hb
+from kubeflow_tpu.orchestrator.spec import WorkerPhase, WorkerStatus
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FiredFault:
+    """Report entry for one injected fault."""
+
+    fault: Fault
+    at_observed_step: int
+    fired_at: float
+    targets: list[str]
+    recovered_after_s: float | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "fault": self.fault.to_dict(),
+            "at_observed_step": self.at_observed_step,
+            "targets": list(self.targets),
+            "recovered_after_s": self.recovered_after_s,
+        }
+
+
+class ChaosRunner:
+    """Injects one FaultPlan into one job; reusable across polls only."""
+
+    def __init__(self, cluster, uid: str, plan: FaultPlan):
+        self.cluster = cluster
+        self.uid = uid
+        self.plan = plan
+        self._rng = random.Random(plan.seed)
+        self._pending: list[Fault] = list(plan.faults)
+        self.fired: list[FiredFault] = []
+        #: PreemptWorker grace enforcement: worker key → (deadline, fault)
+        self._grace: dict[str, tuple[float, Fault]] = {}
+
+    # -- observation ---------------------------------------------------- #
+
+    def _workers(self) -> list[WorkerStatus]:
+        return [
+            w for _, w in self.cluster.workers.list(prefix=f"{self.uid}/")
+        ]
+
+    def observed_step(self) -> int:
+        """Max trainer step this job has demonstrably completed: heartbeat
+        stamps first (the drain writes one per completed step), stdout
+        ``step=N`` metrics as the fallback for payloads that don't beat."""
+        best = -1
+        workdir = self.cluster.launcher.workdir(self.uid)
+        for w in self._workers():
+            beat = hb.read_heartbeat(
+                hb.heartbeat_path(workdir, w.replica_type, w.index)
+            )
+            if beat is not None:
+                best = max(best, beat.step)
+        if best >= 0:
+            return best
+        from kubeflow_tpu.train.metrics import parse_stdout_metrics
+
+        for w in self._workers():
+            try:
+                text = self.cluster.logs(self.uid, w.replica_type, w.index)
+            except OSError:
+                continue
+            for m in parse_stdout_metrics(text):
+                best = max(best, int(m["step"]))
+        return best
+
+    # -- trigger + fire -------------------------------------------------- #
+
+    def _targets(self, fault: Fault) -> list[WorkerStatus]:
+        rtype = getattr(fault, "replica_type", None)
+        index = getattr(fault, "index", None)
+        out = []
+        for w in self._workers():
+            if rtype is not None and w.replica_type != rtype:
+                continue
+            if index is not None and w.index != index:
+                continue
+            out.append(w)
+        return out
+
+    def _triggered(self, fault: Fault, step: int) -> list[WorkerStatus] | bool:
+        """Truthy iff the fault should fire this pass (the worker targets
+        for process faults; ``True`` for targetless checkpoint faults)."""
+        if fault.at_step is not None and step < fault.at_step:
+            return []
+        if isinstance(fault, CorruptCheckpoint):
+            # no process target: gate only on observed step progress
+            return self.cluster.get(self.uid) is not None
+        return [
+            w
+            for w in self._targets(fault)
+            if w.phase is WorkerPhase.RUNNING
+            and w.restarts == fault.on_attempt
+        ]
+
+    def _fire(self, fault: Fault, targets, step: int) -> None:
+        if isinstance(fault, CorruptCheckpoint):
+            _, victim = injectors.corrupt_checkpoint(
+                fault.directory, fault.step, rng=self._rng
+            )
+            logger.warning(
+                "chaos: fired %s at observed step %d on %s",
+                fault.kind, step, victim,
+            )
+            self.fired.append(
+                FiredFault(
+                    fault=fault, at_observed_step=step,
+                    fired_at=time.monotonic(), targets=[victim],
+                )
+            )
+            return
+        keys = [w.key for w in targets]
+        if isinstance(fault, CrashWorker):
+            for k in keys:
+                self.cluster.launcher.kill(k, fault.sig)
+            injectors.record_injection("crash_worker")
+        elif isinstance(fault, PreemptWorker):
+            deadline = time.monotonic() + fault.grace_s
+            for k in keys:
+                self.cluster.launcher.kill(k, int(_signal.SIGTERM))
+                self._grace[k] = (deadline, fault)
+            injectors.record_injection("preempt_worker")
+        elif isinstance(fault, WedgeWorker):
+            for k in keys:
+                self.cluster.launcher.kill(k, int(_signal.SIGSTOP))
+            injectors.record_injection("wedge_worker")
+        elif isinstance(fault, DropSlice):
+            sid = fault.slice_id or next(
+                (w.slice_id for w in targets if w.slice_id), None
+            )
+            if sid is None:
+                logger.warning("chaos: DropSlice found no placed slice; skipped")
+                return
+            self.cluster.fleet.remove_slice(sid)
+            keys = [sid]
+            injectors.record_injection("drop_slice")
+        else:  # pragma: no cover — plan validation keeps this unreachable
+            raise TypeError(f"unknown fault {fault!r}")
+        logger.warning(
+            "chaos: fired %s at observed step %d on %s",
+            fault.kind, step, keys,
+        )
+        self.fired.append(
+            FiredFault(
+                fault=fault,
+                at_observed_step=step,
+                fired_at=time.monotonic(),
+                targets=keys,
+            )
+        )
+
+    def _enforce_grace(self) -> None:
+        """SIGKILL preempted workers that outlived their grace."""
+        now = time.monotonic()
+        for key, (deadline, _fault) in list(self._grace.items()):
+            if not self.cluster.launcher.alive(key):
+                del self._grace[key]
+            elif now >= deadline:
+                logger.warning("chaos: %s outlived preemption grace; SIGKILL", key)
+                self.cluster.launcher.kill(key, int(_signal.SIGKILL))
+                injectors.record_injection("preempt_grace_kill")
+                del self._grace[key]
+
+    def _note_recoveries(self, step: int) -> None:
+        job = self.cluster.get(self.uid)
+        finished_ok = (
+            job is not None and job.status.finished
+            and job.status.phase == "Succeeded"
+        )
+        for rec in self.fired:
+            if rec.recovered_after_s is not None:
+                continue
+            if isinstance(rec.fault, CorruptCheckpoint):
+                continue  # recovery asserted at restore time, not here
+            if finished_ok or step > rec.at_observed_step:
+                rec.recovered_after_s = time.monotonic() - rec.fired_at
+                injectors.RECOVERY_SECONDS.observe(rec.recovered_after_s)
+
+    # -- driving --------------------------------------------------------- #
+
+    def poll(self) -> None:
+        """One pass: enforce preemption grace, evaluate triggers, fire."""
+        self._enforce_grace()
+        step = self.observed_step()
+        still_pending = []
+        for fault in self._pending:
+            targets = self._triggered(fault, step)
+            if targets:
+                self._fire(fault, targets, step)
+            else:
+                still_pending.append(fault)
+        self._pending = still_pending
+        self._note_recoveries(step)
+
+    @property
+    def done(self) -> bool:
+        return not self._pending and not self._grace
+
+    def drive(self, *, timeout: float = 300.0, poll_s: float = 0.05) -> dict:
+        """Poll until the job reaches a terminal condition (or timeout);
+        returns the chaos report. The injection cadence is bounded by
+        ``poll_s`` but every trigger decision keys off observed steps."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.cluster.get(self.uid)
+            if job is None or job.status.finished:
+                break
+            self.poll()
+            time.sleep(poll_s)
+        self._note_recoveries(self.observed_step())
+        job = self.cluster.get(self.uid)
+        return self.report(
+            phase=job.status.phase if job is not None else "Deleted",
+            restart_count=(
+                job.status.restart_count if job is not None else -1
+            ),
+        )
+
+    def report(self, **extra: Any) -> dict:
+        return {
+            "plan": self.plan.to_dict(),
+            "fired": [f.to_dict() for f in self.fired],
+            "pending": [f.to_dict() for f in self._pending],
+            **extra,
+        }

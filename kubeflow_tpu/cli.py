@@ -19,6 +19,9 @@ Subcommands:
   registry, serve REST (+ optional gRPC) until SIGINT.
 - ``kft models``       — model registry verbs (list/show/register/promote/
   rollback/lineage) over the store at ``--root``/``KFT_REGISTRY_ROOT``.
+- ``kft chaos run``    — run Job manifests under a declarative FaultPlan
+  (``--plan plan.yaml``): inject every named failure at its trigger step,
+  report what fired and whether the job recovered.
 - ``kft doctor``       — accelerator liveness via the subprocess probe
   (never hangs on a wedged tunnel) + device inventory.
 - ``kft version``.
@@ -472,6 +475,74 @@ def _cmd_models(args) -> int:
         store.close()
 
 
+def _cmd_chaos(args) -> int:
+    """Run Job manifests under a FaultPlan: the CLI spelling of the chaos
+    harness — inject every declared failure at its trigger step and report
+    whether the platform recovered (exit 0 iff every job Succeeded and
+    every fault fired)."""
+    import yaml
+
+    from kubeflow_tpu.chaos import ChaosRunner, FaultPlan
+    from kubeflow_tpu.orchestrator.cluster import LocalCluster
+    from kubeflow_tpu.orchestrator.envwire import WiringConfig
+    from kubeflow_tpu.orchestrator.resources import Fleet
+    from kubeflow_tpu.orchestrator.spec import JobSpec
+    from kubeflow_tpu.platform import manifests
+
+    with open(args.plan) as f:
+        plan = FaultPlan.from_dict(yaml.safe_load(f) or {})
+    jobs: list[JobSpec] = []
+    for doc in _load_docs(args.file):
+        try:
+            parsed = manifests.parse(doc)
+        except manifests.UnsupportedKind:
+            print(
+                f"kft chaos: skipping unsupported kind {doc.get('kind')!r}",
+                file=sys.stderr,
+            )
+            continue
+        except ValueError as e:
+            print(f"kft chaos: invalid {doc.get('kind')} manifest: {e}",
+                  file=sys.stderr)
+            return 2
+        if isinstance(parsed, JobSpec):
+            jobs.append(parsed)
+    if not jobs:
+        print("kft chaos: no Job manifests found", file=sys.stderr)
+        return 2
+
+    fleet = Fleet.homogeneous(args.slices, args.topology)
+    wiring = WiringConfig(
+        platform=args.platform, devices_per_worker=args.devices_per_worker
+    )
+    failed = 0
+    with LocalCluster(
+        fleet=fleet, wiring=wiring, restart_backoff_base=0.1,
+        resync_period=0.05,
+    ) as cluster:
+        for spec in jobs:
+            uid = cluster.submit(spec)
+            report = ChaosRunner(cluster, uid, plan).drive(
+                timeout=args.timeout
+            )
+            ok = report["phase"] == "Succeeded" and not report["pending"]
+            failed += 0 if ok else 1
+            print(f"job/{spec.name}: {report['phase']} "
+                  f"restarts={report['restart_count']}")
+            for rec in report["fired"]:
+                rc = rec["recovered_after_s"]
+                print(
+                    f"  fired {rec['fault']['kind']} at step "
+                    f"{rec['at_observed_step']} on {rec['targets']}"
+                    + (f" — recovered in {rc:.2f}s" if rc is not None else "")
+                )
+            for fd in report["pending"]:
+                print(f"  NEVER FIRED: {fd['kind']} (at_step={fd['at_step']})")
+            if args.json:
+                print(json.dumps(report))
+    return 1 if failed else 0
+
+
 def _cmd_doctor(args) -> int:
     from kubeflow_tpu.core.deviceprobe import UNREACHABLE, probe_backend
 
@@ -567,6 +638,24 @@ def main(argv: list[str] | None = None) -> int:
     mo.add_argument("-p", "--param", action="append", default=[],
                     help="register: metadata key=value (repeatable)")
     mo.set_defaults(fn=_cmd_models)
+
+    ch = sub.add_parser(
+        "chaos", help="run Job manifests under a fault-injection plan"
+    )
+    ch.add_argument("action", choices=("run",))
+    ch.add_argument("-f", "--file", required=True,
+                    help="Job manifest file or overlay dir")
+    ch.add_argument("--plan", required=True,
+                    help="FaultPlan YAML/JSON ({seed, faults: [{kind, ...}]})")
+    ch.add_argument("--timeout", type=float, default=300.0)
+    ch.add_argument("--slices", type=int, default=1)
+    ch.add_argument("--topology", default="2x2")
+    ch.add_argument("--platform", default="cpu_sim",
+                    choices=("cpu_sim", "tpu"))
+    ch.add_argument("--devices-per-worker", type=int, default=1)
+    ch.add_argument("--json", action="store_true",
+                    help="also print the machine-readable chaos report")
+    ch.set_defaults(fn=_cmd_chaos)
 
     d = sub.add_parser("doctor", help="accelerator liveness + inventory")
     d.add_argument("--timeout", type=float, default=120.0)

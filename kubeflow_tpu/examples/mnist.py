@@ -29,6 +29,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=50)
+    p.add_argument(
+        "--checkpoint-sync", action="store_true",
+        help="synchronous saves: every saved step is durable (with its "
+             "sha256 manifest) before the next step runs — what the chaos "
+             "kill-mid-train tests rely on for exact-step resume",
+    )
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--metrics-logdir", type=str, default=None)
     p.add_argument(
@@ -79,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
                 CheckpointConfig(
                     directory=args.checkpoint_dir,
                     save_every_steps=args.checkpoint_every,
+                    async_save=not args.checkpoint_sync,
                 )
                 if args.checkpoint_dir
                 else None

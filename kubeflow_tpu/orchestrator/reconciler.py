@@ -44,6 +44,11 @@ logger = logging.getLogger(__name__)
 GANG_RESTARTS = prom.REGISTRY.counter(
     "kft_gang_restarts_total", "gang restarts triggered by worker failures"
 )
+GANG_REQUEUES = prom.REGISTRY.counter(
+    "kft_gang_requeues_total",
+    "gangs sent back to the scheduler queue after losing placement",
+    labels=("reason",),
+)
 JOBS_FINISHED = prom.REGISTRY.counter(
     "kft_jobs_finished_total", "jobs reaching a terminal condition",
     labels=("condition", "reason"),
@@ -158,6 +163,18 @@ class JobController:
                     self.jobs.update(uid, job)
                 return
         status = job.status
+
+        # -- slice loss: placement evaporated under a held gang ---------- #
+        lost = sorted(
+            {
+                c.slice_id
+                for c in claims.values()
+                if not self.scheduler.fleet.has_slice(c.slice_id)
+            }
+        )
+        if lost:
+            self._requeue_gang(job, lost)
+            return
 
         # -- placement + launch ---------------------------------------- #
         for w in desired:
@@ -314,6 +331,40 @@ class JobController:
         self._wait_dead(ws)
         for w in ws:
             self.workers.mutate(w.key, _reset_for_restart)
+
+    def _requeue_gang(self, job: JobObject, lost: list[str]) -> None:
+        """A claimed slice vanished (preemption/maintenance — the JobSet
+        failure-policy "recreate" case): kill the survivors, release every
+        claim, and send the whole gang back through gang admission. The
+        job waits as Queued until capacity returns, then relaunches and
+        resumes from checkpoint. Deliberately NOT a failure: slice loss is
+        infra, so it burns neither ``backoff_limit`` budget nor
+        ``restart_count`` (same contract as ``scale``)."""
+        spec, status = job.spec, job.status
+        GANG_REQUEUES.labels(reason="SliceLost").inc()
+        status.push(
+            CT.RESTARTING, reason="SliceLost",
+            message=f"slice(s) {', '.join(lost)} lost; gang requeued",
+        )
+        # new ports per attempt, like a failure restart: dying processes
+        # may still hold the old ones
+        job.coordinator_port = 0
+        job.service_ports = {}
+        self.jobs.update(spec.uid, job)
+        logger.warning(
+            "job %s lost slice(s) %s: requeueing gang", spec.name, lost
+        )
+
+        ws = [w for _, w in self.workers.list(prefix=f"{spec.uid}/")]
+        for w in ws:
+            if w.phase is WorkerPhase.RUNNING:
+                self.launcher.kill(w.key)
+        self._wait_dead(ws)
+        # claims released (release() tolerates the missing slice), queue
+        # entry dropped — the next sync re-enqueues from desired state
+        self.scheduler.cancel(spec.uid)
+        for w in ws:
+            self.workers.mutate(w.key, _reset_for_requeue)
 
     def scale(self, uid: str, replicas: int) -> int:
         """Resize an elastic job's scalable replica group — the HPA-driven
@@ -499,3 +550,14 @@ def _reset_for_restart(w: WorkerStatus) -> None:
     w.exit_code = None
     w.pid = None
     w.message = "awaiting gang restart"
+
+
+def _reset_for_requeue(w: WorkerStatus) -> None:
+    # PENDING, not SCHEDULED: the old claims are gone, so the worker must
+    # flow through gang admission + placement again before launch.
+    w.phase = WorkerPhase.PENDING
+    w.restarts += 1
+    w.exit_code = None
+    w.pid = None
+    w.slice_id = None
+    w.message = "awaiting requeue after slice loss"

@@ -103,6 +103,12 @@ class Fleet:
         with self._lock:
             self._slices.pop(slice_id, None)
 
+    def has_slice(self, slice_id: str) -> bool:
+        """False once a slice is lost — what the reconciler polls to turn
+        an invisible capacity change into a gang requeue."""
+        with self._lock:
+            return slice_id in self._slices
+
     # ------------------------------------------------------------------ #
 
     def snapshot(self) -> dict[str, Slice]:

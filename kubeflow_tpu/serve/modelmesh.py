@@ -20,13 +20,21 @@ next explicit load attempt).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Any, Callable, Mapping
 
 import jax
 
+from kubeflow_tpu.obs import prom
 from kubeflow_tpu.serve.model import Model
+
+LOAD_FAILURES = prom.REGISTRY.counter(
+    "kft_modelmesh_load_failures_total",
+    "model loads that raised (per model entry)",
+    labels=("model",),
+)
 
 
 class ModelState:
@@ -60,6 +68,7 @@ class _Entry:
         self.loads = 0
         self.error: str | None = None
         self.failed_at = 0.0
+        self.cooldown_s = 0.0  # jittered per failure; see ModelMesh._fail
         self.pins = 0  # in-flight requests holding the weights resident
         self.refs = 1  # registrations sharing this entry (rollouts, shared
         #              # components) — deregister removes only at zero
@@ -75,9 +84,13 @@ class ModelMesh:
         *,
         clock=time.monotonic,
         retry_cooldown_s: float = 5.0,
+        retry_jitter: float = 0.2,
+        jitter_seed: int | None = None,
     ):
         if hbm_budget_bytes <= 0:
             raise ValueError("hbm_budget_bytes must be positive")
+        if not 0.0 <= retry_jitter < 1.0:
+            raise ValueError(f"retry_jitter must be in [0, 1), got {retry_jitter}")
         self.budget = int(hbm_budget_bytes)
         self._clock = clock
         self._lock = threading.RLock()
@@ -87,8 +100,14 @@ class ModelMesh:
         #: and slow (weights → HBM); coarse serialization is the right cost.
         self._load_lock = threading.Lock()
         #: a FAILED load becomes retryable after this long (transient
-        #: storage flakes must not be a permanent 503 — see MeshBackedModel)
+        #: storage flakes must not be a permanent 503 — see MeshBackedModel).
+        #: Each failure draws its own cooldown in
+        #: [retry_cooldown_s, retry_cooldown_s * (1 + retry_jitter)) so N
+        #: replicas that all failed on the same broken backend desynchronize
+        #: instead of re-hammering it in lockstep (thundering-herd retry).
         self.retry_cooldown_s = retry_cooldown_s
+        self.retry_jitter = retry_jitter
+        self._rng = random.Random(jitter_seed)
         self._entries: dict[str, _Entry] = {}
         #: deregistered-while-pinned entries: their weights are STILL in HBM
         #: until the last unpin drains them, so budget math must see them
@@ -171,6 +190,7 @@ class ModelMesh:
                 "loads": e.loads,
                 "error": e.error,
                 "failed_at": e.failed_at,
+                "cooldown_s": e.cooldown_s,
             }
 
     # -- placement ---------------------------------------------------------- #
@@ -190,11 +210,11 @@ class ModelMesh:
                 return e.model
             if (
                 e.state == ModelState.FAILED
-                and self._clock() - e.failed_at < self.retry_cooldown_s
+                and self._clock() - e.failed_at < e.cooldown_s
             ):
                 raise RuntimeError(
                     f"model {name!r} failed to load: {e.error} (retry in "
-                    f"{self.retry_cooldown_s:.0f}s)"
+                    f"{e.cooldown_s:.0f}s)"
                 )
         # one load at a time: budget math must never race (see _load_lock)
         with self._load_lock:
@@ -214,10 +234,7 @@ class ModelMesh:
                     model.load()
                 size = _device_bytes(model)
             except Exception as ex:
-                with self._lock:
-                    e.state = ModelState.FAILED
-                    e.error = f"{type(ex).__name__}: {ex}"
-                    e.failed_at = self._clock()
+                self._fail(e, f"{type(ex).__name__}: {ex}")
                 raise RuntimeError(
                     f"model {name!r} failed to load: {ex}"
                 ) from ex
@@ -228,11 +245,9 @@ class ModelMesh:
                     model.unload()
                     raise KeyError(name)
                 if size > self.budget:
-                    e.state = ModelState.FAILED
-                    e.error = (
-                        f"model needs {size} bytes > budget {self.budget}"
+                    self._fail(
+                        e, f"model needs {size} bytes > budget {self.budget}"
                     )
-                    e.failed_at = self._clock()
                     model.unload()
                     raise RuntimeError(e.error)
                 self._evict_locked(need=size, keep=name)
@@ -244,6 +259,27 @@ class ModelMesh:
                 e.last_used = self._clock()
                 self.stats["loads"] += 1
                 return model
+
+    def _fail(self, e: _Entry, error: str) -> None:
+        """Record a load failure: sticky-FAILED with a jittered cooldown."""
+        with self._lock:
+            e.state = ModelState.FAILED
+            e.error = error
+            e.failed_at = self._clock()
+            e.cooldown_s = self.retry_cooldown_s * (
+                1.0 + self._rng.uniform(0.0, self.retry_jitter)
+            )
+        LOAD_FAILURES.labels(model=e.name).inc()
+
+    def cooldown_remaining(self, name: str) -> float:
+        """Seconds until a FAILED entry becomes retryable; 0 when it is
+        not FAILED (or unknown). What readiness probes should consult —
+        the effective cooldown is per-failure jittered."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None or e.state != ModelState.FAILED:
+                return 0.0
+            return max(0.0, e.cooldown_s - (self._clock() - e.failed_at))
 
     def _evict_locked(self, need: int, keep: str) -> None:
         """Evict least-recently-used UNPINNED residents until ``need``
@@ -342,9 +378,10 @@ class MeshBackedModel(Model):
             return True
         # FAILED: not-ready (503) during the cooldown so a broken model
         # doesn't reload-storm; ready again afterwards so the next request
-        # reaches mesh.model(), the ONLY retry path from the data plane
-        age = self._mesh._clock() - info.get("failed_at", 0.0)
-        return age >= self._mesh.retry_cooldown_s
+        # reaches mesh.model(), the ONLY retry path from the data plane.
+        # The cooldown is the per-failure jittered one, so N replicas that
+        # failed together come back staggered.
+        return self._mesh.cooldown_remaining(self.key) <= 0.0
 
     @ready.setter
     def ready(self, value: bool) -> None:

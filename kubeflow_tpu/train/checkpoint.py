@@ -6,16 +6,46 @@ subsystem because checkpoint-restart IS the elasticity model for static SPMD
 worlds (SURVEY.md §5.3). Key capability: restore onto a *different* mesh
 shape than the one that saved (elastic-by-restart after losing a slice) —
 Orbax re-shards on load given target shardings.
+
+Integrity (the chaos-harness contract): every durable save gets a per-file
+sha256 manifest (``_KFT_MANIFEST.json`` inside the step dir, GC'd with it),
+``verify_step`` rechecks it, and ``restore`` walks back to the newest step
+that verifies — a corrupt latest checkpoint costs ``save_every_steps`` of
+progress instead of the whole run. Orbax's own commit is atomic (staged dir
+rename), so a step that exists but predates its manifest write is trusted;
+the manifest catches the silent cases atomicity can't: bit-rot, torn
+copies, and chaos-injected corruption.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import logging
+import os
 from pathlib import Path
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+from kubeflow_tpu.obs import prom
+
+logger = logging.getLogger(__name__)
+
+#: per-file sha256 manifest written inside each step dir once the (possibly
+#: async) save is durable; Orbax's max_to_keep GC removes it with the step.
+MANIFEST_NAME = "_KFT_MANIFEST.json"
+
+RESTORE_FALLBACKS = prom.REGISTRY.counter(
+    "kft_checkpoint_fallbacks_total",
+    "restores that walked past a corrupt/unreadable checkpoint step",
+)
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint step failed its sha256 manifest verification."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +73,9 @@ class Checkpointer:
         #: [(step, RegisterOnSave)] — ingested on the next interval check
         #: (any later ``save``) or at ``wait()``/``close()``.
         self._pending_register: list[tuple[int, Any]] = []
+        #: saved steps whose integrity manifest is not yet written (async
+        #: saves: the files must be durable before they can be hashed).
+        self._pending_manifest: list[int] = []
 
     # ------------------------------------------------------------------ #
 
@@ -62,13 +95,16 @@ class Checkpointer:
         registration is *deferred*: it runs on a later ``save`` call once
         the write has completed (a non-blocking probe), or at
         ``wait()``/``close()`` at the latest. The registered version is
-        exposed as ``self.last_registered``."""
+        exposed as ``self.last_registered``. The integrity manifest is
+        deferred the same way, for the same reason."""
         self._ingest_ready()  # previous interval's save may be durable now
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
-        if saved and register is not None:
-            self._pending_register.append((step, register))
+        if saved:
+            self._pending_manifest.append(step)
+            if register is not None:
+                self._pending_register.append((step, register))
             if self.config.async_save:
                 self._ingest_ready()  # fast saves may already be durable
             else:
@@ -76,13 +112,20 @@ class Checkpointer:
         return saved
 
     def _ingest_ready(self, block: bool = False) -> None:
-        """Register pending saves whose checkpoint write is durable."""
-        if not self._pending_register:
+        """Finalize saves whose checkpoint write is durable: write their
+        sha256 manifests, then run any deferred registrations."""
+        if not (self._pending_register or self._pending_manifest):
             return
         if block:
             self._mgr.wait_until_finished()
         elif self._saving_in_progress():
             return
+        manifests, self._pending_manifest = self._pending_manifest, []
+        for step in manifests:
+            try:
+                self._write_manifest(step)
+            except OSError as e:  # GC'd before finalize / disk trouble
+                logger.warning("manifest for step %d not written: %s", step, e)
         pending, self._pending_register = self._pending_register, []
         for step, register in pending:
             ckpt = self._step_dir(step)
@@ -130,21 +173,114 @@ class Checkpointer:
             f"no checkpoint directory for step {step} under {base}"
         )
 
+    # -- integrity ------------------------------------------------------ #
+
+    def _write_manifest(self, step: int) -> None:
+        """Hash every file of a durable step; rank 0 writes, atomically.
+        Multi-process runs: each process's files are already committed by
+        Orbax's barrier before ``wait_until_finished`` returns, so rank 0
+        sees the complete tree."""
+        if jax.process_index() != 0:
+            return
+        step_dir = Path(self._step_dir(step))
+        manifest = {"step": int(step), "files": _hash_tree(step_dir)}
+        tmp = step_dir / (MANIFEST_NAME + ".tmp")
+        tmp.write_text(json.dumps(manifest, sort_keys=True))
+        os.replace(tmp, step_dir / MANIFEST_NAME)
+
+    def verify_step(self, step: int) -> bool | None:
+        """True: manifest present and every file matches. False: mismatch
+        or unreadable (corrupt). None: no manifest (pre-manifest save or a
+        crash between Orbax's atomic commit and the manifest write) —
+        trusted, since Orbax never commits a partial step."""
+        try:
+            step_dir = Path(self._step_dir(step))
+        except FileNotFoundError:
+            return False
+        mpath = step_dir / MANIFEST_NAME
+        if not mpath.exists():
+            return None
+        try:
+            manifest = json.loads(mpath.read_text())
+            want = manifest["files"]
+        except (OSError, ValueError, KeyError):
+            return False  # torn manifest: can't vouch for the data
+        try:
+            have = _hash_tree(step_dir)
+        except OSError:
+            return False
+        return have == want
+
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, target_state: Any, step: int | None = None) -> Any:
+    def all_steps(self) -> list[int]:
+        return sorted(int(s) for s in self._mgr.all_steps())
+
+    def latest_valid_step(self) -> int | None:
+        """Newest step whose manifest verifies (or that predates
+        manifests); None when every step is corrupt or none exist."""
+        for step in reversed(self.all_steps()):
+            if self.verify_step(step) is not False:
+                return step
+        return None
+
+    # ------------------------------------------------------------------ #
+
+    def restore(
+        self, target_state: Any, step: int | None = None, *,
+        verify: bool = True,
+    ) -> Any:
         """Restore into the shardings of ``target_state`` (an abstract or
         concrete pytree). Because the target carries its own NamedShardings,
         restoring onto a different mesh shape than the writer's is exactly
-        the same call — the elastic-restart path."""
-        step = self.latest_step() if step is None else step
-        if step is None:
+        the same call — the elastic-restart path.
+
+        With ``step=None`` the newest *valid* step is restored: a step that
+        fails its sha256 manifest (or whose Orbax read raises) is skipped
+        with a warning and the walk falls back to the previous one — a
+        corrupt latest checkpoint degrades to lost progress, not a dead
+        job. An explicitly requested ``step`` is never silently
+        substituted: corruption raises ``CorruptCheckpointError``."""
+        abstract = jax.tree_util.tree_map(_abstractify, target_state)
+        if step is not None:
+            if verify and self.verify_step(step) is False:
+                raise CorruptCheckpointError(
+                    f"checkpoint step {step} under {self.config.directory} "
+                    "fails its sha256 manifest"
+                )
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        steps = self.all_steps()
+        if not steps:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.config.directory}"
             )
-        abstract = jax.tree_util.tree_map(_abstractify, target_state)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        last_err: Exception | None = None
+        for s in reversed(steps):
+            if verify and self.verify_step(s) is False:
+                logger.warning(
+                    "checkpoint step %d fails its sha256 manifest; "
+                    "falling back to the previous step", s,
+                )
+                RESTORE_FALLBACKS.inc()
+                continue
+            try:
+                return self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(abstract)
+                )
+            except Exception as e:  # noqa: BLE001 — unreadable ≈ corrupt
+                last_err = e
+                logger.warning(
+                    "checkpoint step %d failed to restore (%s: %s); "
+                    "falling back", s, type(e).__name__, e,
+                )
+                RESTORE_FALLBACKS.inc()
+        raise CorruptCheckpointError(
+            f"every checkpoint under {self.config.directory} is corrupt "
+            f"or unreadable (steps {steps})"
+        ) from last_err
 
     def wait(self) -> None:
         """Block until async saves are durable (call before exit)."""
@@ -161,6 +297,22 @@ class Checkpointer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def _hash_tree(root: Path) -> dict[str, str]:
+    """relpath → sha256 over every file under ``root`` (manifest excluded)."""
+    out: dict[str, str] = {}
+    for dirpath, _, files in os.walk(root):
+        for name in sorted(files):
+            if name == MANIFEST_NAME or name == MANIFEST_NAME + ".tmp":
+                continue
+            p = Path(dirpath) / name
+            h = hashlib.sha256()
+            with open(p, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            out[os.path.relpath(p, root)] = h.hexdigest()
+    return out
 
 
 def _abstractify(x: Any) -> Any:

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import signal as _signal
+import threading
 import time
 from collections.abc import Iterator
 from typing import Any, Callable, Iterable, Mapping
@@ -32,6 +34,20 @@ logger = logging.getLogger(__name__)
 #: batch pytrees are sharded over the data-like axes on dim 0.
 BATCH_SPEC = P((Axis.DATA, Axis.FSDP))
 
+#: the container convention for SIGTERM death (128+15) — retryable under
+#: ``RestartPolicy.EXIT_CODE``, so a preempted gang restarts and resumes.
+PREEMPTED_EXIT_CODE = 143
+
+
+class Preempted(SystemExit):
+    """Raised out of ``fit`` after a preemption notice was honored: the
+    final checkpoint is on disk and the process should exit ``code`` (143,
+    a retryable infra code under ``RestartPolicy.EXIT_CODE``)."""
+
+    def __init__(self, step: int, code: int = PREEMPTED_EXIT_CODE):
+        super().__init__(code)
+        self.step = step
+
 
 class TrainState(train_state.TrainState):
     """flax TrainState + a dropout/noise RNG folded per step."""
@@ -47,8 +63,19 @@ class TrainConfig:
     log_every: int = 10
     seed: int = 0
     checkpoint: CheckpointConfig | None = None
-    resume: bool = True
+    #: True/"auto": restore from the newest checkpoint step whose sha256
+    #: manifest verifies, walking past corrupt steps (train/checkpoint.py);
+    #: False: always start from step 0.
+    resume: bool | str = True
     metrics_logdir: str | None = None
+    #: install a SIGTERM handler for the duration of ``fit`` (main thread
+    #: only — elsewhere the signal machinery is unavailable and the flag
+    #: can still be set via ``Trainer.request_preemption``). On delivery
+    #: the loop finishes the in-flight step, force-saves a final
+    #: preemption checkpoint, and raises ``Preempted`` (SystemExit 143 —
+    #: retryable under ``RestartPolicy.EXIT_CODE``, so the orchestrator
+    #: restarts the gang and training resumes at the exact next step).
+    handle_sigterm: bool = True
     donate_state: bool = True
     #: in-graph gradient accumulation: the jitted step scans over
     #: ``grad_accum_steps`` microbatches (one optimizer update, donated
@@ -89,6 +116,11 @@ class TrainConfig:
             raise ValueError(
                 f"prefetch_depth must be >= 0, got {self.prefetch_depth}"
             )
+        if not isinstance(self.resume, bool) and self.resume != "auto":
+            # a typo must not silently disable (or mis-enable) resume
+            raise ValueError(
+                f"resume={self.resume!r}; expected True, False, or 'auto'"
+            )
         if self.global_batch % self.grad_accum_steps:
             raise ValueError(
                 f"global batch {self.global_batch} not divisible by "
@@ -127,6 +159,15 @@ class Trainer:
         self.repl = NamedSharding(self.mesh, P())
         self._step_fn = None
         self._state_sharding = None
+        #: preemption notice (SIGTERM or an explicit call): the loop checks
+        #: it between steps and performs the graceful-exit protocol.
+        self._preempt = threading.Event()
+
+    def request_preemption(self) -> None:
+        """Deliver a preemption notice in-process (what the SIGTERM handler
+        calls): the loop saves a final checkpoint and raises ``Preempted``
+        at the next step boundary. Safe from any thread."""
+        self._preempt.set()
 
     # ------------------------------------------------------------------ #
 
@@ -349,12 +390,41 @@ class Trainer:
         if hb is not None:
             hb.start()
 
+        # Preemption notice: SIGTERM (a slice being reclaimed) sets a flag
+        # the loop honors at the next step boundary — final checkpoint,
+        # then exit 143 so RestartPolicy.EXIT_CODE treats it as retryable
+        # infra. Signal handlers only install on the main thread; elsewhere
+        # (a fit driven from a server thread) request_preemption() remains
+        # the delivery path.
+        self._preempt.clear()
+        prev_sigterm = None
+        sigterm_installed = False
+        if (
+            cfg.handle_sigterm
+            and threading.current_thread() is threading.main_thread()
+        ):
+            def _on_sigterm(signum, frame):  # noqa: ARG001
+                logger.warning(
+                    "SIGTERM received: taking a preemption checkpoint, "
+                    "then exiting %d", PREEMPTED_EXIT_CODE,
+                )
+                self._preempt.set()
+
+            try:
+                prev_sigterm = _signal.signal(_signal.SIGTERM, _on_sigterm)
+                sigterm_installed = True
+            except (ValueError, OSError):  # exotic embeddings
+                sigterm_installed = False
+
         state = self.init_state()
         ckpt: Checkpointer | None = None
         start_step = 0
         if cfg.checkpoint is not None:
             ckpt = Checkpointer(cfg.checkpoint)
             if cfg.resume and ckpt.latest_step() is not None:
+                # Walks back to the newest step whose sha256 manifest
+                # verifies — a corrupt latest checkpoint costs one save
+                # interval, not the run (train/checkpoint.py).
                 state = ckpt.restore(state)
                 # Re-home the restored tree into XLA-owned buffers (a
                 # non-donating jitted identity is a sharded copy). Orbax
@@ -366,6 +436,10 @@ class Trainer:
                 state = jax.jit(lambda s: s)(state)
                 start_step = int(jax.device_get(state.step))
                 logger.info("resumed from checkpoint at step %d", start_step)
+                if jax.process_index() == 0:
+                    # machine-readable resume marker for supervisors and
+                    # the chaos harness (exact-step resume assertions)
+                    print(f"resume_step={start_step}", flush=True)
         if callable(data) and not hasattr(data, "__next__"):
             it = iter(data(start_step))
         else:
@@ -389,10 +463,19 @@ class Trainer:
                     start_step, t_last, last_logged, hb,
                 )
         finally:
+            if sigterm_installed:
+                try:
+                    _signal.signal(
+                        _signal.SIGTERM,
+                        prev_sigterm if prev_sigterm is not None
+                        else _signal.SIG_DFL,
+                    )
+                except (ValueError, OSError):
+                    pass
             if hb is not None:
                 hb.stop()
             if ckpt is not None:
-                ckpt.close()
+                ckpt.close()  # preemption path: blocks until durable
             if own_writer:
                 writer.close()
 
@@ -419,11 +502,19 @@ class Trainer:
         fetcher = make_fetcher(
             it, self.global_batch_array, depth=cfg.prefetch_depth
         )
-        drain = MetricsDrain(writer, history=history, hooks=hooks)
+        # the drain stamps every completed step into the heartbeat file, so
+        # the supervisor's progress watchdog sees real trainer advancement
+        # (not just thread liveness) without touching the hot loop thread
+        drain = MetricsDrain(
+            writer, history=history, hooks=hooks, heartbeat=hb
+        )
         compile_ms = None
         try:
             for step in range(start_step, cfg.steps):
                 drain.poll()  # bounded-lag NaN alarm / drain-error surface
+                if self._preempt.is_set():
+                    self._preemption_save(ckpt, state, step)
+                    raise Preempted(step)
                 batch = next(fetcher)
                 if compile_ms is None:
                     # block on step 1 so the compile is measured apart; the
@@ -445,9 +536,6 @@ class Trainer:
                 is_log = (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps
                 extra = None
                 if is_log:
-                    if hb is not None:
-                        # stamp progress; the writer thread owns liveness
-                        hb.beat(step + 1)
                     now = time.perf_counter()
                     # dispatch-side rate (compile-inclusive, like the old
                     # loop): the drain only falls back to it for the
@@ -475,6 +563,24 @@ class Trainer:
                 self._final_save(ckpt, state)
         drain.poll()
         return state, history
+
+    @staticmethod
+    def _preemption_save(
+        ckpt: Checkpointer | None, state: TrainState, step: int
+    ) -> None:
+        """The graceful half of preemption: force-save the current state
+        (the loop-top invariant is ``state.step == step``) so the restarted
+        gang resumes at exactly ``step + 1``. Durability is guaranteed by
+        ``ckpt.close()`` in ``fit``'s finally before the exit code lands."""
+        if ckpt is not None and ckpt.latest_step() != step:
+            ckpt.save(step, state, force=True)
+        logger.warning(
+            "preempted at step %d: final checkpoint %s; exiting %d",
+            step,
+            "saved" if ckpt is not None else "unavailable (no checkpoint "
+            "config)",
+            PREEMPTED_EXIT_CODE,
+        )
 
     @staticmethod
     def _final_save(ckpt: Checkpointer, state: TrainState) -> None:

@@ -257,12 +257,18 @@ class MetricsDrain:
         history: list[dict],
         hooks=(),
         depth: int = 64,
+        heartbeat=None,
     ):
         from kubeflow_tpu.train.metrics import set_overlap_gauges, _to_scalar
 
         self._to_scalar = _to_scalar
         self._set_gauges = set_overlap_gauges
         self._writer = writer
+        #: obs.heartbeat.HeartbeatWriter (or None): every drained step is
+        #: stamped into the beat file, so the orchestrator supervisor's
+        #: ``progress_timeout_seconds`` watches real step advancement — a
+        #: wedged loop thread with a live beat thread is detectable.
+        self._hb = heartbeat
         self._history = history
         self._hooks = tuple(hooks or ())
         self._q: queue.Queue = queue.Queue(maxsize=depth)
@@ -347,6 +353,10 @@ class MetricsDrain:
             # block_until_ready here corrupts the heap on this jaxlib when
             # the step's donated state came from an Orbax restore
             np.asarray(leaves[0])
+        if self._hb is not None:
+            # step N's metrics are ready ⇒ step N completed on device:
+            # the honest progress stamp for the supervisor's watchdog
+            self._hb.beat(step)
         now = time.perf_counter()
         if self._last_ready is not None:
             self._win_step_s += now - self._last_ready

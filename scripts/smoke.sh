@@ -101,4 +101,47 @@ print(f"fit OK: steps_per_sec={history[-1]['steps_per_sec']:.3g} "
       f"compile_ms={history[0]['compile_ms']:.1f}")
 EOF
 
+echo "== kill-and-resume: SIGTERM mid-train -> 143 -> exact-step resume =="
+python - <<'EOF'
+import os, re, signal, subprocess, sys, tempfile, time
+
+tmp = tempfile.mkdtemp(prefix="kft-smoke-preempt-")
+ckpt = os.path.join(tmp, "ckpt")
+cmd = [
+    sys.executable, "-m", "kubeflow_tpu.examples.mnist",
+    "--steps", "8", "--global-batch", "16", "--log-every", "1",
+    "--checkpoint-dir", ckpt, "--checkpoint-every", "1",
+    "--checkpoint-sync",
+]
+env = {**os.environ, "PYTHONUNBUFFERED": "1",
+       "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+log = os.path.join(tmp, "run0.log")
+with open(log, "wb") as f:
+    proc = subprocess.Popen(cmd, stdout=f, stderr=subprocess.STDOUT, env=env)
+    # preemption notice once training demonstrably reached step >= 2
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        text = open(log, errors="replace").read()
+        if re.search(r"^step=2 ", text, re.M):
+            proc.send_signal(signal.SIGTERM)
+            break
+        if proc.poll() is not None:
+            sys.exit(f"trainer exited early:\n{text}")
+        time.sleep(0.1)
+    rc = proc.wait(timeout=120)
+text = open(log, errors="replace").read()
+assert rc == 143, f"expected preemption exit 143, got {rc}:\n{text}"
+assert "preempted at step" in text, text
+
+out = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=300)
+assert out.returncode == 0, out.stdout + out.stderr
+m = re.search(r"resume_step=(\d+)", out.stdout)
+assert m, f"no resume marker:\n{out.stdout}"
+resume = int(m.group(1))
+steps = [int(s) for s in re.findall(r"^step=(\d+) ", out.stdout, re.M)]
+assert resume >= 2 and steps == list(range(resume + 1, 9)), (resume, steps)
+print(f"kill-and-resume OK: preempted run exited 143, resumed at "
+      f"step {resume + 1}, finished 8")
+EOF
+
 echo "smoke OK"

@@ -1,0 +1,626 @@
+"""Chaos harness: inject every failure we claim to survive — and survive it.
+
+Tier-1 by design (deterministic seeds, step-keyed triggers, no long sleeps):
+
+- FaultPlan declarative surface (roundtrip, validation);
+- kill-mid-train e2e: a FaultPlan SIGKILLs a worker at an observed trainer
+  step; the gang restarts and resumes from the latest valid checkpoint at
+  the exact next step — no repeated, no skipped steps;
+- corrupt-latest checkpoint: restore detects the sha256-manifest mismatch
+  and falls back to the previous step instead of dying or loading garbage;
+- preemption: SIGTERM mid-fit → final checkpoint → exit code 143
+  (retryable under RestartPolicy.EXIT_CODE) → exact-step resume;
+- slice loss: the reconciler requeues the gang (no backoff burned) until
+  capacity returns;
+- wedge: SIGSTOP freezes a worker without exiting; the heartbeat
+  supervisor detects and the gang recovers;
+- storage fault injection through the fetcher-registry seam (retries,
+  corruption rejection);
+- `kft chaos run` CLI.
+"""
+
+import re
+import signal
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.chaos import (
+    ChaosRunner,
+    CorruptCheckpoint,
+    CrashWorker,
+    DropSlice,
+    FaultPlan,
+    PreemptWorker,
+    WedgeWorker,
+    corrupt_checkpoint,
+    storage_faults,
+)
+from kubeflow_tpu.obs.prom import REGISTRY
+from kubeflow_tpu.orchestrator import (
+    ElasticPolicy,
+    JobSpec,
+    LocalCluster,
+    ReplicaSpec,
+    RestartPolicy,
+    TPURequest,
+)
+from kubeflow_tpu.orchestrator.envwire import WiringConfig
+from kubeflow_tpu.orchestrator.resources import Fleet, Slice
+from kubeflow_tpu.orchestrator.spec import JobConditionType as CT, WorkerPhase
+from kubeflow_tpu.train.metrics import parse_stdout_metrics
+
+REPO = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+
+pytestmark = pytest.mark.chaos
+
+
+def _counter_value(name: str, **labels) -> float:
+    metric = REGISTRY._metrics.get(name)
+    if metric is None:
+        return 0.0
+    child = metric._children.get(tuple(sorted(labels.items())))
+    return child.value if child is not None else 0.0
+
+
+# --------------------------------------------------------------------- #
+# plan surface
+# --------------------------------------------------------------------- #
+
+
+def test_faultplan_roundtrip_and_validation():
+    plan = FaultPlan(
+        faults=(
+            CrashWorker(at_step=3, index=1, sig=9),
+            PreemptWorker(at_step=5, index=None, grace_s=2.0),
+            WedgeWorker(),
+            DropSlice(slice_id="slice-0"),
+            CorruptCheckpoint(directory="/tmp/c", at_step=4),
+        ),
+        seed=42,
+    )
+    again = FaultPlan.from_dict(plan.to_dict())
+    assert again == plan
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.from_dict({"faults": [{"kind": "Meteor"}]})
+    with pytest.raises(TypeError):
+        FaultPlan(faults=("not a fault",))
+
+
+# --------------------------------------------------------------------- #
+# the acceptance e2e: kill mid-train, resume at the exact next step
+# --------------------------------------------------------------------- #
+
+
+def test_chaos_kill_mid_train_resumes_exact_next_step(tmp_path):
+    """FaultPlan SIGKILLs worker-0 once the trainer's heartbeat shows step
+    >= 3. ExitCode policy restarts the gang; attempt 1 must restore the
+    newest durable checkpoint (sync saves every step ⇒ step >= 3) and
+    log exactly resume_step+1 .. steps: nothing repeated, nothing skipped,
+    loss stream continuous across the crash."""
+    cluster = LocalCluster(
+        fleet=Fleet.homogeneous(1, "2x2"),
+        wiring=WiringConfig(platform="cpu_sim", devices_per_worker=2),
+        base_dir=str(tmp_path),
+        restart_backoff_base=0.05,
+        resync_period=0.05,
+    )
+    injected0 = _counter_value("kft_chaos_injected_total", kind="crash_worker")
+    with cluster:
+        job = JobSpec(
+            name="chaos-mnist",
+            replicas={
+                "worker": ReplicaSpec(
+                    replicas=1,
+                    command=(
+                        PY, "-m", "kubeflow_tpu.examples.mnist",
+                        "--steps", "8", "--global-batch", "16",
+                        "--log-every", "1",
+                        "--checkpoint-dir", str(tmp_path / "ckpt"),
+                        "--checkpoint-every", "1", "--checkpoint-sync",
+                    ),
+                    env={"PYTHONPATH": REPO},
+                    restart_policy=RestartPolicy.EXIT_CODE,
+                    tpu=TPURequest(chips=2),
+                )
+            },
+        )
+        uid = cluster.submit(job)
+        plan = FaultPlan(
+            faults=(CrashWorker(at_step=3, index=0, sig=9),), seed=1
+        )
+        report = ChaosRunner(cluster, uid, plan).drive(timeout=240)
+
+        log_all = cluster.logs(uid, "worker", 0)
+        assert report["phase"] == "Succeeded", f"log:\n{log_all}"
+        assert report["restart_count"] == 1
+        assert not report["pending"]
+        [fired] = report["fired"]
+        assert fired["fault"]["kind"] == "CrashWorker"
+        assert fired["at_observed_step"] >= 3
+        assert fired["recovered_after_s"] is not None
+
+        # exact-step resume: attempt 1 declares where it restored from,
+        # and its logged steps are precisely the continuation
+        log1 = cluster.logs(uid, "worker", 0, attempt=1)
+        m = re.search(r"resume_step=(\d+)", log1)
+        assert m, f"no resume marker in attempt-1 log:\n{log1}"
+        resume_step = int(m.group(1))
+        assert resume_step >= 3  # sync save every step: nothing older
+        steps1 = [int(x["step"]) for x in parse_stdout_metrics(log1)]
+        assert steps1 == list(range(resume_step + 1, 9)), steps1
+        # loss continuity: the resumed stream is real training, not a
+        # restart from scratch (which would re-log step 1)
+        losses1 = [x["loss"] for x in parse_stdout_metrics(log1)]
+        assert losses1 and all(v == v for v in losses1)  # finite stream
+        # nothing attempt 0 logged lies past the restore point: a logged
+        # step implies its sync save was already durable (loop order), so
+        # the restored step can never skip logged progress
+        steps0 = [int(x["step"]) for x in parse_stdout_metrics(
+            cluster.logs(uid, "worker", 0, attempt=0)
+        )]
+        assert steps0 and max(steps0) <= resume_step, (steps0, resume_step)
+
+    # recovery observability landed on the shared registry
+    assert _counter_value(
+        "kft_chaos_injected_total", kind="crash_worker"
+    ) == injected0 + 1
+    assert "kft_recovery_seconds" in REGISTRY.expose()
+
+
+# --------------------------------------------------------------------- #
+# corrupt latest checkpoint → manifest-verified fallback
+# --------------------------------------------------------------------- #
+
+
+def _mnist_trainer(steps, ckpt_dir, **cfg_kw):
+    import optax
+
+    from kubeflow_tpu.core.mesh import MeshSpec
+    from kubeflow_tpu.models.mnist_cnn import (
+        MnistCNN, make_init_fn, make_loss_fn,
+    )
+    from kubeflow_tpu.train.checkpoint import CheckpointConfig
+    from kubeflow_tpu.train.loop import TrainConfig, Trainer
+
+    model = MnistCNN()
+    return Trainer(
+        init_params=make_init_fn(model),
+        loss_fn=make_loss_fn(model),
+        optimizer=optax.adam(3e-3),
+        config=TrainConfig(
+            mesh=MeshSpec.data_parallel(8),
+            global_batch=32,
+            steps=steps,
+            log_every=1,
+            checkpoint=CheckpointConfig(
+                directory=str(ckpt_dir), save_every_steps=1,
+                async_save=False, max_to_keep=10,
+            ),
+            **cfg_kw,
+        ),
+    )
+
+
+def _data(start_step=0):
+    from kubeflow_tpu.data.synthetic import (
+        ClassPrototypeDataset, local_shard_iterator,
+    )
+
+    return local_shard_iterator(
+        ClassPrototypeDataset(), 32, start_step=start_step
+    )
+
+
+def test_corrupt_latest_checkpoint_restore_falls_back(tmp_path, devices8):
+    from kubeflow_tpu.train.checkpoint import (
+        CheckpointConfig, Checkpointer, CorruptCheckpointError,
+    )
+
+    ckpt_dir = tmp_path / "ckpt"
+    t1 = _mnist_trainer(4, ckpt_dir, resume="auto")
+    t1.fit(lambda s: _data(s))
+
+    step, victim = corrupt_checkpoint(ckpt_dir)  # flips a byte in step 4
+    assert step == 4 and Path(victim).exists()
+
+    cfg = CheckpointConfig(directory=str(ckpt_dir), async_save=False)
+    with Checkpointer(cfg) as c:
+        assert c.verify_step(4) is False  # manifest catches the flip
+        assert c.verify_step(3) is True
+        assert c.latest_step() == 4      # Orbax itself is none the wiser
+        assert c.latest_valid_step() == 3
+        # explicitly requested corrupt step: loud failure, no substitution
+        with pytest.raises(CorruptCheckpointError):
+            c.restore({"x": 0}, step=4)
+
+    # fit(resume='auto') walks back to step 3 and re-trains 4..6
+    t2 = _mnist_trainer(6, ckpt_dir, resume="auto")
+    state, history = t2.fit(lambda s: _data(s))
+    assert int(state.step) == 6
+    assert [h["step"] for h in history] == [4, 5, 6]
+
+
+def test_every_checkpoint_corrupt_raises(tmp_path, devices8):
+    from kubeflow_tpu.train.checkpoint import (
+        CheckpointConfig, Checkpointer, CorruptCheckpointError,
+    )
+
+    ckpt_dir = tmp_path / "ckpt"
+    t1 = _mnist_trainer(2, ckpt_dir)
+    state, _ = t1.fit(lambda s: _data(s))
+    for step in (1, 2):
+        corrupt_checkpoint(ckpt_dir, step)
+    with Checkpointer(
+        CheckpointConfig(directory=str(ckpt_dir), async_save=False)
+    ) as c:
+        assert c.latest_valid_step() is None
+        with pytest.raises(CorruptCheckpointError, match="every checkpoint"):
+            c.restore(state)
+
+
+# --------------------------------------------------------------------- #
+# preemption: SIGTERM → final checkpoint → 143 → exact-step resume
+# --------------------------------------------------------------------- #
+
+
+def test_preemption_sigterm_checkpoints_and_exits_143(tmp_path, devices8):
+    from kubeflow_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+    from kubeflow_tpu.train.loop import Preempted
+
+    ckpt_dir = tmp_path / "ckpt"
+    # interval saves disabled (every 1000): the only checkpoint a preempted
+    # run can leave is the forced preemption save
+    trainer = _mnist_trainer(12, ckpt_dir)
+    trainer.config.checkpoint = CheckpointConfig(
+        directory=str(ckpt_dir), save_every_steps=1000, async_save=False
+    )
+    fired = []
+
+    def deliver_sigterm(step, _metrics):
+        if step >= 2 and not fired:
+            fired.append(step)
+            import os
+
+            os.kill(os.getpid(), signal.SIGTERM)  # real signal delivery
+
+    before = signal.getsignal(signal.SIGTERM)
+    with pytest.raises(Preempted) as exc:
+        trainer.fit(lambda s: _data(s), hooks=[deliver_sigterm])
+    assert exc.value.code == 143  # retryable under RestartPolicy.EXIT_CODE
+    preempt_step = exc.value.step
+    assert preempt_step >= 2
+    assert signal.getsignal(signal.SIGTERM) == before  # handler restored
+
+    with Checkpointer(
+        CheckpointConfig(directory=str(ckpt_dir), async_save=False)
+    ) as c:
+        assert c.latest_step() == preempt_step  # the forced final save
+        assert c.verify_step(preempt_step) is True
+
+    # resume is the exact continuation
+    t2 = _mnist_trainer(12, ckpt_dir, resume="auto")
+    state, history = t2.fit(lambda s: _data(s))
+    assert int(state.step) == 12
+    assert [h["step"] for h in history] == list(range(preempt_step + 1, 13))
+
+
+def test_request_preemption_without_signal(tmp_path, devices8):
+    """The non-main-thread delivery path: request_preemption() alone must
+    trigger the same checkpoint-and-143 protocol."""
+    from kubeflow_tpu.train.loop import Preempted
+
+    trainer = _mnist_trainer(12, tmp_path / "ckpt")
+    trainer.config.handle_sigterm = False
+
+    def hook(step, _m):
+        if step >= 2:
+            trainer.request_preemption()
+
+    with pytest.raises(Preempted) as exc:
+        trainer.fit(lambda s: _data(s), hooks=[hook])
+    assert exc.value.code == 143
+
+
+def test_preempt_worker_grace_kill(tmp_path):
+    """A worker that ignores SIGTERM is SIGKILLed at the grace deadline —
+    and the gang still recovers (137 is retryable)."""
+    code = (
+        "import os, signal, time, sys;"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN);"
+        "sys.exit(0) if os.environ['KFT_ATTEMPT'] != '0' else time.sleep(60)"
+    )
+    cluster = LocalCluster(
+        fleet=Fleet.homogeneous(1, "2x2"),
+        base_dir=str(tmp_path),
+        restart_backoff_base=0.05,
+        resync_period=0.05,
+    )
+    g0 = _counter_value("kft_chaos_injected_total", kind="preempt_grace_kill")
+    with cluster:
+        job = JobSpec(
+            name="stubborn",
+            replicas={
+                "worker": ReplicaSpec(
+                    replicas=1,
+                    command=(PY, "-c", code),
+                    restart_policy=RestartPolicy.EXIT_CODE,
+                )
+            },
+        )
+        uid = cluster.submit(job)
+        plan = FaultPlan(faults=(PreemptWorker(index=None, grace_s=0.3),))
+        report = ChaosRunner(cluster, uid, plan).drive(timeout=60)
+        assert report["phase"] == "Succeeded"
+        assert report["restart_count"] == 1
+    assert _counter_value(
+        "kft_chaos_injected_total", kind="preempt_grace_kill"
+    ) == g0 + 1
+
+
+# --------------------------------------------------------------------- #
+# slice loss → gang requeue → recovery when capacity returns
+# --------------------------------------------------------------------- #
+
+
+def test_slice_loss_requeues_then_recovers(tmp_path):
+    cluster = LocalCluster(
+        fleet=Fleet.homogeneous(1, "2x2"),
+        base_dir=str(tmp_path),
+        restart_backoff_base=0.05,
+        resync_period=0.05,
+    )
+    with cluster:
+        job = JobSpec(
+            name="slice-victim",
+            replicas={
+                "worker": ReplicaSpec(
+                    replicas=2,
+                    # long-lived on the doomed attempt, quick exit after the
+                    # requeue relaunch — keeps the injection window wide and
+                    # the test fast
+                    command=(
+                        PY, "-c",
+                        "import os, time; time.sleep("
+                        "5.0 if os.environ['KFT_ATTEMPT'] == '0' else 0.2)",
+                    ),
+                    tpu=TPURequest(chips=1),
+                )
+            },
+        )
+        uid = cluster.submit(job)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            ws = cluster.workers.list(prefix=f"{uid}/")
+            if ws and all(w.phase is WorkerPhase.RUNNING for _, w in ws):
+                break
+            time.sleep(0.02)
+        runner = ChaosRunner(
+            cluster, uid, FaultPlan(faults=(DropSlice(index=0),))
+        )
+        runner.poll()
+        assert runner.done
+
+        # the gang goes back through admission and waits as Queued
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            st = cluster.status(uid)
+            if st and any(
+                c.type is CT.QUEUED and c.status for c in st.conditions
+            ):
+                break
+            time.sleep(0.02)
+        st = cluster.status(uid)
+        restarting = [c for c in st.conditions if c.type is CT.RESTARTING]
+        assert restarting and restarting[0].reason == "SliceLost"
+
+        # capacity returns → relaunch at attempt 1 → success, and slice
+        # loss burned NO failure-backoff budget
+        cluster.fleet.add_slice(Slice("slice-respawn", "2x2"))
+        status = cluster.wait(uid, timeout=30)
+        assert status.phase == "Succeeded"
+        assert status.restart_count == 0
+        assert all(
+            w.restarts == 1
+            for _, w in cluster.workers.list(prefix=f"{uid}/")
+        )
+
+
+# --------------------------------------------------------------------- #
+# wedged worker (SIGSTOP): supervisor detection → gang recovery
+# --------------------------------------------------------------------- #
+
+#: beats by hand (no framework import → starts in milliseconds), exits 0
+#: after a short life; a SIGSTOP freezes the beats without an exit.
+BEAT_THEN_EXIT = """
+import json, os, threading, time
+workdir = os.environ["KFT_WORKDIR"]
+rtype = os.environ["KFT_REPLICA_TYPE"]
+index = os.environ["KFT_REPLICA_INDEX"]
+attempt = int(os.environ["KFT_ATTEMPT"])
+path = os.path.join(workdir, f"heartbeat-{rtype}-{index}.json")
+def beat():
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"time": time.time(), "pid": os.getpid(),
+                   "step": 1, "attempt": attempt}, f)
+    os.replace(tmp, path)
+beat()
+def pump():
+    while True:
+        beat(); time.sleep(0.05)
+threading.Thread(target=pump, daemon=True).start()
+# long-lived on attempt 0 (wide injection window for the SIGSTOP), quick
+# clean exit once the supervisor-driven restart proves recovery
+time.sleep(5.0 if attempt == 0 else 0.2)
+"""
+
+
+def test_wedge_worker_supervisor_recovers(tmp_path):
+    cluster = LocalCluster(
+        fleet=Fleet.homogeneous(1, "2x2"),
+        base_dir=str(tmp_path),
+        restart_backoff_base=0.05,
+        resync_period=0.05,
+    )
+    with cluster:
+        job = JobSpec(
+            name="wedged",
+            replicas={
+                "worker": ReplicaSpec(
+                    replicas=1,
+                    command=(PY, "-c", BEAT_THEN_EXIT),
+                    restart_policy=RestartPolicy.ON_FAILURE,
+                )
+            },
+            elastic=ElasticPolicy(
+                heartbeat_timeout_seconds=0.4,
+                heartbeat_grace_seconds=10.0,
+            ),
+        )
+        uid = cluster.submit(job)
+        plan = FaultPlan(faults=(WedgeWorker(at_step=1, index=0),))
+        report = ChaosRunner(cluster, uid, plan).drive(timeout=60)
+        # frozen process never exits on its own; the supervisor must have
+        # killed it (stale beat) and the restarted attempt finishes clean
+        assert report["phase"] == "Succeeded"
+        assert report["restart_count"] == 1
+        assert not report["pending"]
+
+
+# --------------------------------------------------------------------- #
+# storage / transfer fault injection
+# --------------------------------------------------------------------- #
+
+
+def test_storage_faults_transient_failures_are_retried(tmp_path):
+    from kubeflow_tpu.serve import storage
+
+    src = tmp_path / "weights.bin"
+    src.write_bytes(b"x" * 1024)
+    with storage_faults(fail=2) as stats:
+        out = storage.download(
+            str(src), str(tmp_path / "dest"), retries=3, backoff_s=0.01
+        )
+    assert Path(out).read_bytes() == b"x" * 1024
+    assert stats["failed"] == 2
+    assert storage.verify(out, uri=str(src))
+
+
+def test_storage_faults_exhausted_retries_surface(tmp_path):
+    from kubeflow_tpu.serve import storage
+
+    src = tmp_path / "weights.bin"
+    src.write_bytes(b"y" * 64)
+    with storage_faults(fail=5):
+        with pytest.raises(RuntimeError, match="failed after 3 attempts"):
+            storage.download(
+                str(src), str(tmp_path / "dest"), retries=3, backoff_s=0.01
+            )
+
+
+def test_storage_faults_corruption_rejected_by_pin(tmp_path):
+    """A silently-corrupting transfer must never satisfy an
+    expected_sha256 pin — every attempt corrupts, so the download fails
+    loudly instead of serving flipped bytes."""
+    import hashlib
+
+    from kubeflow_tpu.serve import storage
+
+    payload = b"model-bytes" * 100
+    src = tmp_path / "model.bin"
+    src.write_bytes(payload)
+    want = hashlib.sha256(payload).hexdigest()
+    with storage_faults(corrupt_every=1) as stats:
+        with pytest.raises(RuntimeError, match="checksum mismatch|failed"):
+            storage.download(
+                str(src), str(tmp_path / "dest"),
+                retries=2, backoff_s=0.01, expected_sha256=want,
+            )
+    assert stats["corrupted"] >= 1
+    # and WITHOUT the fault, the same pin succeeds (the harness restored
+    # the fetcher registry on exit)
+    out = storage.download(
+        str(src), str(tmp_path / "dest2"), expected_sha256=want
+    )
+    assert Path(out).read_bytes() == payload
+
+
+def test_storage_faults_cover_registry_scheme(tmp_path):
+    """registry:// transfers flow through the same faultable seam: a
+    transient flake on the blob copy is retried and the content-hash pin
+    still holds end to end."""
+    from kubeflow_tpu.registry.store import ModelStore, set_default_store
+    from kubeflow_tpu.serve import storage
+
+    payload = b"registered-model-bytes"
+    src = tmp_path / "m.bin"
+    src.write_bytes(payload)
+    store = ModelStore(str(tmp_path / "registry"))
+    set_default_store(store)
+    try:
+        store.register_version("chaos-model", str(src), stage="production")
+        with storage_faults(fail=1) as stats:
+            out = storage.download(
+                "registry://chaos-model@production",
+                str(tmp_path / "dest"), retries=3, backoff_s=0.01,
+            )
+        assert Path(out).read_bytes() == payload
+        assert stats["failed"] == 1
+    finally:
+        set_default_store(None)
+        store.close()
+
+
+# --------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------- #
+
+
+def test_kft_chaos_run_cli(tmp_path, capsys):
+    import yaml
+
+    from kubeflow_tpu.cli import main
+
+    code = (
+        "import os, sys, time;"
+        "time.sleep(5.0) if os.environ['KFT_ATTEMPT'] == '0' "
+        "else sys.exit(0)"
+    )
+    job = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": "chaos-cli"},
+        "spec": {
+            "replicaSpecs": {
+                "Worker": {
+                    "replicas": 1,
+                    "restartPolicy": "ExitCode",
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {"command": [PY, "-c", code]}
+                            ]
+                        }
+                    },
+                }
+            }
+        },
+    }
+    jf = tmp_path / "job.yaml"
+    jf.write_text(yaml.safe_dump(job))
+    pf = tmp_path / "plan.yaml"
+    pf.write_text(yaml.safe_dump({
+        "seed": 3,
+        "faults": [{"kind": "CrashWorker", "index": 0, "sig": 9}],
+    }))
+    rc = main([
+        "chaos", "run", "-f", str(jf), "--plan", str(pf), "--timeout", "60",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "job/chaos-cli: Succeeded" in out
+    assert "fired CrashWorker" in out

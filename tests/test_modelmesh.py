@@ -386,3 +386,89 @@ def test_explain_through_mesh_backed_model():
     mesh = ModelMesh(4 * PER_MODEL)
     proxy = MeshBackedModel(mesh, "a", factory)
     assert proxy.explain({"instances": [[1]]}) == {"explanations": ["ok"]}
+
+
+def test_retry_cooldown_jitter_desynchronizes_replicas():
+    """Each load failure draws its cooldown in
+    [cooldown, cooldown*(1+jitter)): N replicas that failed on the same
+    broken backend come back staggered, not in lockstep."""
+    def broken():
+        raise OSError("backend down")
+
+    cooldowns = []
+    for seed in range(6):
+        t = [0.0]
+        mesh = ModelMesh(
+            4 * PER_MODEL, clock=lambda: t[0], retry_cooldown_s=5.0,
+            retry_jitter=0.2, jitter_seed=seed,
+        )
+        mesh.register("b", broken)
+        with pytest.raises(RuntimeError):
+            mesh.model("b")
+        cd = mesh.readiness("b")["cooldown_s"]
+        assert 5.0 <= cd < 6.0
+        cooldowns.append(cd)
+        # rejected strictly inside the jittered window...
+        t[0] = cd - 0.01
+        with pytest.raises(RuntimeError, match="retry in"):
+            mesh.model("b")
+        # ...retryable right after it (and the retry calls the factory)
+        t[0] = cd + 0.01
+        with pytest.raises(RuntimeError, match="backend down"):
+            mesh.model("b")
+    assert len(set(cooldowns)) > 1  # seeds actually desynchronize
+
+
+def test_mesh_backed_model_ready_uses_jittered_cooldown():
+    t = [0.0]
+    mesh = ModelMesh(
+        4 * PER_MODEL, clock=lambda: t[0], retry_cooldown_s=5.0,
+        retry_jitter=0.5, jitter_seed=123,
+    )
+
+    def broken():
+        raise OSError("nope")
+
+    proxy = MeshBackedModel(mesh, "m", broken)
+    with pytest.raises(RuntimeError):
+        mesh.model("m")
+    cd = mesh.readiness("m")["cooldown_s"]
+    assert cd > 5.0  # this seed drew real jitter
+    t[0] = 5.0
+    assert not proxy.ready  # base cooldown elapsed but jitter has not
+    t[0] = cd
+    assert proxy.ready
+    assert mesh.cooldown_remaining("m") == 0.0
+
+
+def test_modelmesh_load_failure_counter():
+    from kubeflow_tpu.obs.prom import REGISTRY
+
+    def broken():
+        raise ValueError("bad weights")
+
+    mesh = ModelMesh(4 * PER_MODEL, retry_cooldown_s=0.0)
+    mesh.register("counted", broken)
+    for _ in range(3):
+        with pytest.raises(RuntimeError):
+            mesh.model("counted")
+    text = REGISTRY.expose()
+    assert 'kft_modelmesh_load_failures_total{model="counted"} 3' in text
+
+
+def test_retry_jitter_validation():
+    with pytest.raises(ValueError, match="retry_jitter"):
+        ModelMesh(1024, retry_jitter=1.5)
+    # jitter 0 keeps the exact legacy cooldown
+    t = [0.0]
+    mesh = ModelMesh(
+        1024, clock=lambda: t[0], retry_cooldown_s=5.0, retry_jitter=0.0
+    )
+
+    def broken():
+        raise OSError("x")
+
+    mesh.register("z", broken)
+    with pytest.raises(RuntimeError):
+        mesh.model("z")
+    assert mesh.readiness("z")["cooldown_s"] == 5.0

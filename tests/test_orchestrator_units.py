@@ -221,3 +221,123 @@ def test_gang_timeout():
     timed = sched.timed_out()
     assert [g.job_uid for g in timed] == ["imposs"]
     assert sched.pending_count() == 0
+
+
+# ----------------------- failure-policy mechanics ---------------------- #
+
+
+def test_fleet_slice_loss_visibility_and_release_tolerance():
+    fleet = Fleet.homogeneous(2, "2x2")
+    claims = fleet.claim_gang([(4, None, "v5e")])
+    assert claims is not None
+    sid = claims[0].slice_id
+    assert fleet.has_slice(sid)
+    fleet.remove_slice(sid)
+    assert not fleet.has_slice(sid)
+    # releasing claims against a lost slice must be a no-op, not a crash
+    fleet.release(claims)
+    assert fleet.free_chips() == 4  # only the surviving slice counts
+
+
+def test_active_deadline_expiry_drives_failed_condition(tmp_path):
+    """RunPolicy.activeDeadlineSeconds enforcement, driven directly
+    through the reconciler with a fabricated start time — no wall-clock
+    waiting on the deadline itself."""
+    import time
+
+    from kubeflow_tpu.orchestrator.cluster import LocalCluster
+    from kubeflow_tpu.orchestrator.spec import RunPolicy, WorkerPhase
+
+    cluster = LocalCluster(base_dir=str(tmp_path))  # NOT started: we sync
+    job = JobSpec(
+        name="deadline",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=1,
+                command=(PY, "-c", "import time; time.sleep(60)"),
+                tpu=TPURequest(chips=1),
+            )
+        },
+        run_policy=RunPolicy(active_deadline_seconds=5.0),
+    )
+    uid = cluster.submit(job)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        cluster.controller.sync_all()
+        st = cluster.status(uid)
+        if st is not None and st.start_time is not None:
+            break
+        time.sleep(0.02)
+    assert cluster.status(uid).start_time is not None
+
+    # job "has been running" longer than the deadline: next sync fails it
+    def _age(j):
+        j.status.start_time = time.time() - 6.0
+
+    cluster.jobs.mutate(uid, _age)
+    cluster.controller.sync_job(uid)
+    st = cluster.status(uid)
+    assert st.phase == "Failed"
+    assert st.condition().reason == "DeadlineExceeded"
+    # cleanPodPolicy killed the sleeper
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if not any(
+            cluster.launcher.alive(k)
+            for k, _ in cluster.workers.list(prefix=f"{uid}/")
+        ):
+            break
+        time.sleep(0.02)
+    for k, _w in cluster.workers.list(prefix=f"{uid}/"):
+        assert not cluster.launcher.alive(k)
+    cluster.launcher.shutdown()
+
+
+def test_reconciler_requeues_gang_on_slice_loss(tmp_path):
+    """The reconcile-level slice-loss contract, synchronously: lost
+    placement ⇒ RESTARTING/SliceLost, claims released, workers reset to
+    PENDING at attempt 1, and NO restart/backoff budget burned."""
+    import time
+
+    from kubeflow_tpu.orchestrator.cluster import LocalCluster
+    from kubeflow_tpu.orchestrator.spec import (
+        JobConditionType as CT, WorkerPhase,
+    )
+
+    cluster = LocalCluster(base_dir=str(tmp_path))
+    job = JobSpec(
+        name="lost-slice",
+        replicas={
+            "worker": ReplicaSpec(
+                replicas=1,
+                command=(PY, "-c", "import time; time.sleep(60)"),
+                tpu=TPURequest(chips=1),
+            )
+        },
+    )
+    uid = cluster.submit(job)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        cluster.controller.sync_all()
+        ws = cluster.workers.list(prefix=f"{uid}/")
+        if ws and all(w.phase is WorkerPhase.RUNNING for _, w in ws):
+            break
+        time.sleep(0.02)
+    [(key, w)] = cluster.workers.list(prefix=f"{uid}/")
+    assert w.slice_id is not None
+    cluster.fleet.remove_slice(w.slice_id)
+
+    cluster.controller.sync_job(uid)
+    st = cluster.status(uid)
+    restarting = [c for c in st.conditions if c.type is CT.RESTARTING]
+    assert restarting and restarting[0].reason == "SliceLost"
+    assert st.restart_count == 0  # infra loss burns no backoff budget
+    [(key, w)] = cluster.workers.list(prefix=f"{uid}/")
+    assert w.phase is WorkerPhase.PENDING
+    assert w.restarts == 1 and w.slice_id is None
+    assert cluster.scheduler.claims_for(uid) is None
+    # no capacity left: the gang queues instead of failing
+    cluster.controller.sync_job(uid)
+    st = cluster.status(uid)
+    assert any(c.type is CT.QUEUED and c.status for c in st.conditions)
+    cluster.launcher.shutdown()
