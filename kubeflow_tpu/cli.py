@@ -14,6 +14,12 @@ Subcommands:
 - ``kft run -f <path>``— submit every Job/Experiment manifest in a file or
   overlay dir to an in-process LocalCluster, wait for terminal conditions,
   stream failure logs, exit 0 iff everything Succeeded.
+- ``kft jobs submit -f <path>`` — ``kft run`` with scheduling overrides:
+  ``--queue``/``--priority`` plumb into ``SchedulingPolicy``; an unknown
+  LocalQueue is rejected at submit time with a clear error.
+- ``kft queues list/show`` — quota queues (Kueue ClusterQueue analog):
+  declared config from ``-f``, or live usage/borrowed/wait percentiles
+  from a dashboard ``--server``.
 - ``kft serve -f <path>`` — materialise an InferenceService manifest:
   storage-initialize the model, resolve its runtime from the default
   registry, serve REST (+ optional gRPC) until SIGINT.
@@ -67,57 +73,92 @@ def _cmd_build(args) -> int:
 
 
 def _cmd_run(args) -> int:
+    import dataclasses
+
     from kubeflow_tpu.orchestrator.cluster import LocalCluster
     from kubeflow_tpu.orchestrator.envwire import WiringConfig
     from kubeflow_tpu.orchestrator.resources import Fleet
     from kubeflow_tpu.orchestrator.spec import JobConditionType, JobSpec
+    from kubeflow_tpu.orchestrator.webhooks import AdmissionError
     from kubeflow_tpu.platform import manifests
     from kubeflow_tpu.platform.volumes import VolumeSpec
+    from kubeflow_tpu.sched.queues import ClusterQueue, LocalQueue
     from kubeflow_tpu.tune.spec import ExperimentSpec
 
+    prog = f"kft {args.cmd}"
     jobs: list[JobSpec] = []
     experiments: list[ExperimentSpec] = []
-    for doc in _load_docs(args.file):
+    queue_specs: list = []
+    docs = _load_docs(args.file)
+    if getattr(args, "queues", None):  # extra queue manifests ride along
+        docs = list(docs) + _load_docs(args.queues)
+    for doc in docs:
         try:
             parsed = manifests.parse(doc)
         except manifests.UnsupportedKind:
             # kubectl semantics: apply what we know, note what we skip
             print(
-                f"kft run: skipping unsupported kind "
+                f"{prog}: skipping unsupported kind "
                 f"{doc.get('kind')!r}",
                 file=sys.stderr,
             )
             continue
         except ValueError as e:  # supported kind, broken manifest: surface
-            print(f"kft run: invalid {doc.get('kind')} manifest: {e}",
+            print(f"{prog}: invalid {doc.get('kind')} manifest: {e}",
                   file=sys.stderr)
             return 2
         if isinstance(parsed, JobSpec):
             jobs.append(parsed)
         elif isinstance(parsed, ExperimentSpec):
             experiments.append(parsed)
+        elif isinstance(parsed, (ClusterQueue, LocalQueue)):
+            queue_specs.append(parsed)
         elif isinstance(parsed, dict):  # ConfigMap — nothing to run
             continue
         elif isinstance(parsed, VolumeSpec):  # PVC — nothing to run
             continue
         else:
             print(
-                f"kft run: {doc.get('kind')!r} is not runnable here "
+                f"{prog}: {doc.get('kind')!r} is not runnable here "
                 "(use `kft serve` for InferenceService)",
                 file=sys.stderr,
             )
             return 2
     if not jobs and not experiments:
-        print("kft run: no runnable manifests found", file=sys.stderr)
+        print(f"{prog}: no runnable manifests found", file=sys.stderr)
         return 2
+
+    # --queue/--priority plumb straight into SchedulingPolicy
+    if getattr(args, "queue", None) is not None or getattr(
+        args, "priority", None
+    ) is not None:
+        for spec in jobs:
+            sched = spec.run_policy.scheduling
+            if args.queue is not None:
+                sched = dataclasses.replace(sched, queue=args.queue)
+            if args.priority is not None:
+                sched = dataclasses.replace(sched, priority=args.priority)
+            spec.run_policy = dataclasses.replace(
+                spec.run_policy, scheduling=sched
+            )
 
     fleet = Fleet.homogeneous(args.slices, args.topology)
     wiring = WiringConfig(
         platform=args.platform, devices_per_worker=args.devices_per_worker
     )
     failed = 0
-    with LocalCluster(fleet=fleet, wiring=wiring) as cluster:
-        uids = [(spec, cluster.submit(spec)) for spec in jobs]
+    with LocalCluster(
+        fleet=fleet, wiring=wiring, queues=queue_specs or None
+    ) as cluster:
+        uids = []
+        for spec in jobs:
+            try:
+                uids.append((spec, cluster.submit(spec)))
+            except AdmissionError as e:
+                # e.g. an unknown LocalQueue — reject loudly at submit time
+                print(f"{prog}: job/{spec.name} rejected: {e}",
+                      file=sys.stderr)
+                return 2
         deadline = time.monotonic() + args.timeout
         for spec, uid in uids:
             try:
@@ -272,7 +313,14 @@ def _pipeline_ir(path: str, name: str | None = None):
     return PipelineIR.from_dict(doc.get("spec", doc))
 
 
-def _api(server: str, method: str, path: str, body: dict | None = None) -> dict:
+def _api(
+    server: str,
+    method: str,
+    path: str,
+    body: dict | None = None,
+    *,
+    prog: str = "kft pipeline",
+) -> dict:
     import urllib.request
 
     req = urllib.request.Request(
@@ -289,10 +337,10 @@ def _api(server: str, method: str, path: str, body: dict | None = None) -> dict:
 
         if isinstance(e, urllib.error.HTTPError):
             raise SystemExit(
-                f"kft pipeline: {method} {path} → HTTP {e.code}: "
+                f"{prog}: {method} {path} → HTTP {e.code}: "
                 f"{e.read().decode(errors='replace')[:500]}"
             ) from e
-        raise SystemExit(f"kft pipeline: cannot reach {server}: {e}") from e
+        raise SystemExit(f"{prog}: cannot reach {server}: {e}") from e
 
 
 def _parse_params(pairs: list[str]) -> dict:
@@ -475,6 +523,102 @@ def _cmd_models(args) -> int:
         store.close()
 
 
+def _cmd_queues(args) -> int:
+    """Queue verbs (the ``kueuectl list/describe`` analog): render the
+    declared ClusterQueue/LocalQueue config from ``-f`` manifests, or the
+    live quota/usage/wait view from a dashboard server (``--server``)."""
+    from kubeflow_tpu.platform import manifests
+    from kubeflow_tpu.sched.queues import (
+        ClusterQueue, LocalQueue, QueueConfig,
+    )
+
+    if args.server:
+        rows = _api(args.server, "GET", "/api/queues", prog="kft queues")
+    else:
+        if not args.file:
+            raise SystemExit(
+                "kft queues: need -f QUEUES_YAML (ClusterQueue/LocalQueue "
+                "manifests) or --server DASHBOARD_URL"
+            )
+        specs = []
+        for doc in _load_docs(args.file):
+            try:
+                parsed = manifests.parse(doc)
+            except (manifests.UnsupportedKind, ValueError):
+                continue
+            if isinstance(parsed, (ClusterQueue, LocalQueue)):
+                specs.append(parsed)
+        try:
+            config = QueueConfig.from_specs(specs)
+        except ValueError as e:
+            print(f"kft queues: invalid queue config: {e}", file=sys.stderr)
+            return 2
+        rows = [
+            {
+                "name": cq.name,
+                "cohort": cq.cohort,
+                "nominal": dict(cq.quota),
+                "usage": {},
+                "borrowed": {},
+                "borrowing_limit": cq.borrowing_limit,
+                "preemption": cq.preemption.to_dict(),
+                "local_queues": config.local_queues_of(cq.name),
+                "admitted": None,
+                "pending": None,
+                "wait_p50_s": None,
+                "wait_p95_s": None,
+            }
+            for cq in config.cluster_queues.values()
+        ]
+
+    def fmt_chips(d):
+        return ",".join(f"{g}:{c}" for g, c in sorted(d.items())) or "-"
+
+    if args.action == "list":
+        for r in rows:
+            print(
+                f"{r['name']}\tcohort={r['cohort'] or '-'}\t"
+                f"nominal={fmt_chips(r['nominal'])}\t"
+                f"used={fmt_chips(r['usage'])}\t"
+                f"borrowed={fmt_chips(r['borrowed'])}\t"
+                f"pending={r['pending'] if r['pending'] is not None else '-'}\t"
+                f"localqueues={','.join(r['local_queues']) or '-'}"
+            )
+        return 0
+
+    # show NAME
+    if not args.name:
+        raise SystemExit("kft queues show: NAME is required")
+    row = next((r for r in rows if r["name"] == args.name), None)
+    if row is None:
+        print(
+            f"kft queues show: unknown ClusterQueue {args.name!r} "
+            f"(known: {sorted(r['name'] for r in rows)})",
+            file=sys.stderr,
+        )
+        return 1
+    p50, p95 = row["wait_p50_s"], row["wait_p95_s"]
+    print(f"name:            {row['name']}")
+    print(f"cohort:          {row['cohort'] or '-'}")
+    print(f"nominal chips:   {fmt_chips(row['nominal'])}")
+    print(f"used chips:      {fmt_chips(row['usage'])}")
+    print(f"borrowed chips:  {fmt_chips(row['borrowed'])}")
+    print(f"borrowing limit: {row['borrowing_limit'] if row['borrowing_limit'] is not None else 'unbounded'}")
+    print(f"preemption:      {json.dumps(row['preemption'], sort_keys=True)}")
+    print(f"local queues:    {', '.join(row['local_queues']) or '-'}")
+    print(f"admitted:        {row['admitted'] if row['admitted'] is not None else '-'}")
+    print(f"pending:         {row['pending'] if row['pending'] is not None else '-'}")
+    print(
+        "queue wait:      "
+        + (
+            f"p50={p50:.3f}s p95={p95:.3f}s"
+            if p50 is not None
+            else "no admissions observed"
+        )
+    )
+    return 0
+
+
 def _cmd_chaos(args) -> int:
     """Run Job manifests under a FaultPlan: the CLI spelling of the chaos
     harness — inject every declared failure at its trigger step and report
@@ -573,18 +717,51 @@ def main(argv: list[str] | None = None) -> int:
     b.add_argument("path")
     b.set_defaults(fn=_cmd_build)
 
+    def add_run_flags(parser) -> None:
+        parser.add_argument("-f", "--file", required=True,
+                            help="manifest file or overlay dir")
+        parser.add_argument("--timeout", type=float, default=300.0)
+        parser.add_argument("--logs", action="store_true",
+                            help="print worker logs even on success")
+        parser.add_argument("--slices", type=int, default=1)
+        parser.add_argument("--topology", default="2x2")
+        parser.add_argument("--platform", default="cpu_sim",
+                            choices=("cpu_sim", "tpu"))
+        parser.add_argument("--devices-per-worker", type=int, default=1)
+        parser.add_argument("--queue", default=None,
+                            help="submit every job to this LocalQueue "
+                                 "(overrides schedulingPolicy.queue)")
+        parser.add_argument("--priority", type=int, default=None,
+                            help="scheduling priority for every job "
+                                 "(overrides schedulingPolicy.priorityValue)")
+        parser.add_argument("--queues", default=None,
+                            help="ClusterQueue/LocalQueue manifest file — "
+                                 "enables quota scheduling (queue manifests "
+                                 "inside -f work too)")
+
     r = sub.add_parser("run", help="run Job/Experiment manifests to completion")
-    r.add_argument("-f", "--file", required=True,
-                   help="manifest file or overlay dir")
-    r.add_argument("--timeout", type=float, default=300.0)
-    r.add_argument("--logs", action="store_true",
-                   help="print worker logs even on success")
-    r.add_argument("--slices", type=int, default=1)
-    r.add_argument("--topology", default="2x2")
-    r.add_argument("--platform", default="cpu_sim",
-                   choices=("cpu_sim", "tpu"))
-    r.add_argument("--devices-per-worker", type=int, default=1)
+    add_run_flags(r)
     r.set_defaults(fn=_cmd_run)
+
+    jb = sub.add_parser(
+        "jobs", help="job verbs: submit manifests with scheduling overrides"
+    )
+    jb.add_argument("action", choices=("submit",))
+    add_run_flags(jb)
+    jb.set_defaults(fn=_cmd_run)
+
+    q = sub.add_parser(
+        "queues", help="quota queues: list/show ClusterQueues"
+    )
+    q.add_argument("action", choices=("list", "show"))
+    q.add_argument("name", nargs="?", default=None,
+                   help="show: ClusterQueue name")
+    q.add_argument("-f", "--file", default=None,
+                   help="ClusterQueue/LocalQueue manifest file or overlay")
+    q.add_argument("--server", default=None,
+                   help="dashboard base URL for the live quota/usage/wait "
+                        "view (default: static view of -f)")
+    q.set_defaults(fn=_cmd_queues)
 
     s = sub.add_parser("serve", help="serve InferenceService manifests")
     s.add_argument("-f", "--file", required=True)

@@ -47,6 +47,8 @@ class LocalCluster:
         resync_period: float = 0.1,
         restart_backoff_base: float = 1.0,
         admission: "AdmissionChain | None" = None,
+        queues=None,
+        preemption_grace_seconds: float = 5.0,
     ):
         self.fleet = fleet or Fleet.single_host(chips=8)
         self.wiring = wiring or WiringConfig(platform="cpu_sim")
@@ -75,8 +77,27 @@ class LocalCluster:
         else:
             self.jobs = ObjectStore("jobs")
         self.workers = ObjectStore("workers")
-        self.scheduler = GangScheduler(self.fleet)
+        if queues is not None:
+            # multi-tenant quota admission (the Kueue analog): queues may
+            # be a QueueConfig or an iterable of queue specs/manifests
+            from kubeflow_tpu.sched import QueueConfig, QuotaScheduler
+
+            config = (
+                queues
+                if isinstance(queues, QueueConfig)
+                else QueueConfig.from_specs(queues)
+            )
+            self.scheduler: GangScheduler = QuotaScheduler(
+                self.fleet,
+                config,
+                preemption_grace_seconds=preemption_grace_seconds,
+            )
+        else:
+            self.scheduler = GangScheduler(self.fleet)
         self.launcher = ProcessLauncher(self.workers, self.base_dir)
+        self.supervisor = HeartbeatSupervisor(
+            self.jobs, self.workers, self.launcher
+        )
         self.controller = JobController(
             self.jobs,
             self.workers,
@@ -84,11 +105,17 @@ class LocalCluster:
             self.launcher,
             self.wiring,
             restart_backoff_base=restart_backoff_base,
-        )
-        self.supervisor = HeartbeatSupervisor(
-            self.jobs, self.workers, self.launcher
+            supervisor=self.supervisor,
         )
         self.admission = admission or AdmissionChain()
+        if queues is not None:
+            from kubeflow_tpu.orchestrator.webhooks import (
+                queue_membership_validator,
+            )
+
+            self.admission.add_validator(
+                queue_membership_validator(self.scheduler)
+            )
         # admission validators read live state (quota usage); serializing
         # admit+create closes the check-then-act window between concurrent
         # submits (concurrent deletes only free capacity, the safe direction)
@@ -144,6 +171,9 @@ class LocalCluster:
             self._thread.join(timeout=5)
             self._thread = None
         self.launcher.shutdown()
+        close = getattr(self.scheduler, "close", None)
+        if close is not None:  # QuotaScheduler: drop its /metrics collector
+            close()
 
     def __enter__(self) -> "LocalCluster":
         return self.start()

@@ -10,6 +10,13 @@ Policy: per-queue strict priority, then FIFO; no backfill past a blocked
 higher-priority gang within the same queue (prevents starvation of large
 gangs — the failure mode strict gang scheduling exists to avoid). Separate
 queues (``SchedulingPolicy.queue``) are independent.
+
+Multi-tenant admission lives above this class:
+``kubeflow_tpu.sched.scheduler.QuotaScheduler`` subclasses it, treating
+``PodGroup.queue`` as a LocalQueue name and replacing ``try_schedule`` with
+quota-aware admission (nominal quotas, cohort borrowing, preemption) while
+reusing the same all-or-nothing ``Fleet.claim_gang`` topology claims via
+``_admit_locked``.
 """
 
 from __future__ import annotations
@@ -80,6 +87,21 @@ class GangScheduler:
                 del self._pending[g.job_uid]
             return out
 
+    def _admit_locked(self, g: PodGroup) -> bool:
+        """Claim fleet capacity for one pending gang (lock held); on
+        success fills ``g.claims`` and moves it pending → held."""
+        claims = self.fleet.claim_gang(
+            [(chips, topo, gen) for _, chips, topo, gen in g.requests]
+        )
+        if claims is None:
+            return False
+        g.claims = {
+            g.requests[i][0]: claims[i] for i in range(len(claims))
+        }
+        del self._pending[g.job_uid]
+        self._held[g.job_uid] = g
+        return True
+
     def try_schedule(self) -> list[PodGroup]:
         """Admit every gang that fits, honoring per-queue priority+FIFO
         without skipping a blocked head-of-line gang. Returns newly admitted
@@ -92,16 +114,8 @@ class GangScheduler:
             for q, groups in by_queue.items():
                 groups.sort(key=lambda g: (-g.priority, g.enqueued_at))
                 for g in groups:
-                    claims = self.fleet.claim_gang(
-                        [(chips, topo, gen) for _, chips, topo, gen in g.requests]
-                    )
-                    if claims is None:
+                    if not self._admit_locked(g):
                         break  # head-of-line blocks the rest of this queue
-                    g.claims = {
-                        g.requests[i][0]: claims[i] for i in range(len(claims))
-                    }
-                    del self._pending[g.job_uid]
-                    self._held[g.job_uid] = g
                     admitted.append(g)
         return admitted
 

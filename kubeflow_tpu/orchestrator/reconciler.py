@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import signal
 import time
 
 from kubeflow_tpu.obs import prom
@@ -69,6 +70,9 @@ class JobObject:
     deletion_requested: bool = False
     #: pending elastic resize target for the scalable group (None = none).
     resize_to: int | None = None
+    #: SIGTERM-to-SIGKILL deadline while the quota scheduler preempts this
+    #: gang (None = no preemption in flight).
+    preempt_deadline: float | None = None
 
 
 class JobController:
@@ -84,6 +88,7 @@ class JobController:
         *,
         restart_backoff_base: float = 1.0,
         kill_wait_seconds: float = 5.0,
+        supervisor=None,
     ):
         self.jobs = jobs
         self.workers = workers
@@ -92,6 +97,9 @@ class JobController:
         self.wiring = wiring
         self.restart_backoff_base = restart_backoff_base
         self.kill_wait_seconds = kill_wait_seconds
+        #: HeartbeatSupervisor to detach when an attempt is torn down
+        #: (requeue paths); optional so envtest-style setups stay light.
+        self.supervisor = supervisor
 
     # ------------------------------------------------------------------ #
 
@@ -163,6 +171,18 @@ class JobController:
                     self.jobs.update(uid, job)
                 return
         status = job.status
+
+        # -- scheduler-initiated preemption ------------------------------ #
+        # Either the quota scheduler holds an intent against this gang, or
+        # a drive is already in flight (deadline stamped) — the preemptor
+        # may have vanished mid-drive, but a SIGTERMed gang must still be
+        # requeued, not mistaken for a crash that burns backoff budget.
+        requested = getattr(self.scheduler, "preemption_requested", None)
+        if job.preempt_deadline is not None or (
+            requested is not None and requested(uid)
+        ):
+            self._drive_preemption(job)
+            return
 
         # -- slice loss: placement evaporated under a held gang ---------- #
         lost = sorted(
@@ -332,6 +352,78 @@ class JobController:
         for w in ws:
             self.workers.mutate(w.key, _reset_for_restart)
 
+    def _drive_preemption(self, job: JobObject) -> None:
+        """Evict a gang the quota scheduler chose as a victim, through the
+        graceful path preemption-tolerant training already understands:
+        SIGTERM (the trainer force-checkpoints and exits 143) → grace →
+        SIGKILL stragglers → claims released and the gang requeued
+        ``Queued`` with ``reason=Preempted``. Deliberately NOT a failure:
+        like slice loss, eviction is the platform's doing, so it burns
+        neither ``backoff_limit`` budget nor ``restart_count`` — the victim
+        resumes from its forced checkpoint when capacity returns."""
+        spec, status = job.spec, job.status
+        uid = spec.uid
+        ws = [w for _, w in self.workers.list(prefix=f"{uid}/")]
+
+        if job.preempt_deadline is None:
+            grace = getattr(
+                self.scheduler, "preemption_grace_seconds", 5.0
+            )
+            status.push(
+                CT.RESTARTING, reason="Preempting",
+                message="quota reclaimed; checkpointing before requeue",
+            )
+            job.preempt_deadline = time.time() + grace
+            self.jobs.update(uid, job)
+            logger.warning(
+                "job %s preempted by the quota scheduler; SIGTERM "
+                "(grace %.1fs)", spec.name, grace,
+            )
+            for w in ws:
+                if w.phase is WorkerPhase.RUNNING:
+                    self.launcher.kill(w.key, signal.SIGTERM)
+            return
+
+        alive = [w for w in ws if self.launcher.alive(w.key)]
+        if alive:
+            if time.time() >= job.preempt_deadline:
+                for w in alive:  # outlived the checkpoint grace
+                    self.launcher.kill(w.key)
+            return  # resync passes poll until every process is down
+
+        # Every process is down: release placement and requeue the gang.
+        GANG_REQUEUES.labels(reason="Preempted").inc()
+        status.push(
+            CT.RESTARTING, reason="Preempted",
+            message="gang preempted; requeued awaiting quota",
+        )
+        job.preempt_deadline = None
+        # new ports per attempt, like every other gang teardown
+        job.coordinator_port = 0
+        job.service_ports = {}
+        self.jobs.update(uid, job)
+        self.scheduler.cancel(uid)  # claims freed; preemption intent cleared
+        self._detach_attempt(job, ws)
+        for w in ws:
+            self.workers.mutate(w.key, _reset_for_preempt)
+        logger.warning("job %s preemption complete: gang requeued", spec.name)
+
+    def _detach_attempt(self, job: JobObject, ws: list[WorkerStatus]) -> None:
+        """Fully detach a torn-down attempt before its gang goes back to
+        Queued: drop heartbeat files and supervisor watch state. Without
+        this, a stale beat/progress clock from the dead attempt could fire
+        ``progress_timeout`` against a job that is intentionally queued, and
+        chaos step-observation would read the old attempt's progress."""
+        # lazy: obs.heartbeat imports orchestrator.envwire (cycle otherwise)
+        from kubeflow_tpu.obs.heartbeat import heartbeat_path
+
+        for w in ws:
+            heartbeat_path(
+                self.launcher.workdir(job.spec.uid), w.replica_type, w.index
+            ).unlink(missing_ok=True)
+        if self.supervisor is not None:
+            self.supervisor.forget_job(job.spec.uid)
+
     def _requeue_gang(self, job: JobObject, lost: list[str]) -> None:
         """A claimed slice vanished (preemption/maintenance — the JobSet
         failure-policy "recreate" case): kill the survivors, release every
@@ -363,6 +455,7 @@ class JobController:
         # claims released (release() tolerates the missing slice), queue
         # entry dropped — the next sync re-enqueues from desired state
         self.scheduler.cancel(spec.uid)
+        self._detach_attempt(job, ws)
         for w in ws:
             self.workers.mutate(w.key, _reset_for_requeue)
 
@@ -561,3 +654,12 @@ def _reset_for_requeue(w: WorkerStatus) -> None:
     w.pid = None
     w.slice_id = None
     w.message = "awaiting requeue after slice loss"
+
+
+def _reset_for_preempt(w: WorkerStatus) -> None:
+    w.phase = WorkerPhase.PENDING
+    w.restarts += 1
+    w.exit_code = None
+    w.pid = None
+    w.slice_id = None
+    w.message = "awaiting requeue after preemption"

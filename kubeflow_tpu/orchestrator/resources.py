@@ -123,6 +123,44 @@ class Fleet:
         with self._lock:
             return sum(s.free_chips for s in self._slices.values())
 
+    def _plan_locked(
+        self,
+        free: dict[str, int],
+        requests: list[tuple[int, str | None, str]],
+    ) -> list[Claim] | None:
+        """Placement planning over a free-chips map (lock held); mutates
+        ``free`` as it places. Returns claims in request order, or None."""
+        # Place whole-slice (topology) requests first: they are the most
+        # constrained.
+        order = sorted(
+            range(len(requests)),
+            key=lambda i: (requests[i][1] is None, -requests[i][0]),
+        )
+        placed: dict[int, Claim] = {}
+        for i in order:
+            chips, topo, gen = requests[i]
+            candidates = []
+            for sid, s in self._slices.items():
+                if s.generation != gen:
+                    continue
+                if topo is not None:
+                    if s.topology != topo or free[sid] != s.total_chips:
+                        continue
+                    need = s.total_chips
+                else:
+                    need = chips
+                    if free[sid] < need:
+                        continue
+                candidates.append((free[sid], sid, need))
+            if not candidates:
+                return None
+            # Best-fit: least free capacity that still fits.
+            candidates.sort()
+            _, sid, need = candidates[0]
+            free[sid] -= need
+            placed[i] = Claim(sid, need)
+        return [placed[i] for i in range(len(requests))]
+
     def claim_gang(
         self,
         requests: list[tuple[int, str | None, str]],
@@ -137,41 +175,29 @@ class Fleet:
         """
         with self._lock:
             free = {k: s.free_chips for k, s in self._slices.items()}
-            claims: list[Claim] = []
-            # Place whole-slice (topology) requests first: they are the most
-            # constrained.
-            order = sorted(
-                range(len(requests)),
-                key=lambda i: (requests[i][1] is None, -requests[i][0]),
-            )
-            placed: dict[int, Claim] = {}
-            for i in order:
-                chips, topo, gen = requests[i]
-                candidates = []
-                for sid, s in self._slices.items():
-                    if s.generation != gen:
-                        continue
-                    if topo is not None:
-                        if s.topology != topo or free[sid] != s.total_chips:
-                            continue
-                        need = s.total_chips
-                    else:
-                        need = chips
-                        if free[sid] < need:
-                            continue
-                    candidates.append((free[sid], sid, need))
-                if not candidates:
-                    return None
-                # Best-fit: least free capacity that still fits.
-                candidates.sort()
-                _, sid, need = candidates[0]
-                free[sid] -= need
-                placed[i] = Claim(sid, need)
-            for i in range(len(requests)):
-                claims.append(placed[i])
+            claims = self._plan_locked(free, requests)
+            if claims is None:
+                return None
             for c in claims:
                 self._slices[c.slice_id].free_chips -= c.chips
             return claims
+
+    def fits_gang(
+        self,
+        requests: list[tuple[int, str | None, str]],
+        extra_free: "dict[str, int] | None" = None,
+    ) -> bool:
+        """Feasibility probe: would the gang place if ``extra_free`` chips
+        (slice_id → chips) were returned to their slices first? Claims
+        nothing — this is how the quota scheduler asks "would evicting
+        these victims actually make room for the preemptor"."""
+        with self._lock:
+            free = {k: s.free_chips for k, s in self._slices.items()}
+            for sid, chips in (extra_free or {}).items():
+                s = self._slices.get(sid)
+                if s is not None:
+                    free[sid] = min(free[sid] + chips, s.total_chips)
+            return self._plan_locked(free, requests) is not None
 
     def release(self, claims: list[Claim]) -> None:
         with self._lock:

@@ -117,7 +117,15 @@ class TPURequest:
 
 @dataclasses.dataclass(frozen=True)
 class SchedulingPolicy:
-    """Gang scheduling knobs (the Volcano PodGroup analog)."""
+    """Gang scheduling knobs (the Volcano PodGroup analog).
+
+    Under plain gang scheduling ``queue`` is an opaque label (independent
+    FIFO lanes). When the cluster runs the quota scheduler
+    (``LocalCluster(queues=...)``), ``queue`` names a **LocalQueue**
+    (``kubeflow_tpu.sched``) whose ClusterQueue's chip quota admits the
+    gang — unknown names are rejected at submission, and ``priority``
+    additionally orders preemption victim selection.
+    """
 
     gang: bool = True
     min_available: int | None = None  # default: all replicas

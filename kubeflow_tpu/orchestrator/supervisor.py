@@ -67,6 +67,17 @@ class HeartbeatSupervisor:
         #: same tag → (last observed heartbeat step, when it last advanced)
         self._progress: dict[tuple[str, int, int | None], tuple[int, float]] = {}
 
+    def forget_job(self, uid: str) -> None:
+        """Drop every watch tag for a job whose attempt was torn down
+        (preemption/slice-loss requeue). Without this, grace/progress
+        clocks started against the dead attempt would survive into the
+        intentionally-Queued job and bill its next attempt for time it
+        never ran."""
+        prefix = f"{uid}/"
+        for tags in (self._running_since, self._progress):
+            for tag in [t for t in tags if t[0].startswith(prefix)]:
+                del tags[tag]
+
     def check(self, now: float | None = None) -> list[str]:
         """One supervision pass; returns the keys it killed."""
         now = time.time() if now is None else now

@@ -62,3 +62,21 @@ def validate_scheduling(spec: JobSpec) -> None:
             f"schedulingPolicy.minAvailable {sched.min_available} exceeds "
             f"total replicas {spec.total_replicas}"
         )
+
+
+def queue_membership_validator(scheduler) -> Validator:
+    """When quota scheduling is on, every job must name a **known**
+    LocalQueue — a typo'd queue would otherwise sit Queued forever with no
+    signal (the Kueue webhook's localQueueName validation). Installed by
+    ``LocalCluster`` whenever it is built with ``queues=``."""
+
+    def validate(spec: JobSpec) -> None:
+        queue = spec.run_policy.scheduling.queue
+        if not scheduler.knows_queue(queue):
+            raise AdmissionError(
+                f"unknown LocalQueue {queue!r}: known queues are "
+                f"{scheduler.known_queues()} — declare a LocalQueue "
+                "manifest for it or submit with an existing --queue"
+            )
+
+    return validate

@@ -10,6 +10,7 @@ Read API:
 - ``GET /api/summary``      → counts per plane + fleet snapshot
 - ``GET /api/jobs``         → job list (phase, kind, replicas, restarts)
 - ``GET /api/jobs/{uid}/logs?replica=&index=`` → worker logs
+- ``GET /api/queues``       → quota queues (nominal/used/borrowed, waits)
 - ``GET /api/profiles``     → profiles with live quota usage
 - ``GET /api/notebooks``    → notebook phases + idle times
 - ``GET /api/tensorboards`` → board phases + urls
@@ -172,6 +173,13 @@ class DashboardServer(ThreadedAiohttpServer):
             }
             for t in self.tune_db.load_trials(name)
         ]
+
+    def queues_view(self) -> list[dict]:
+        """Quota queues (the Kueue UI analog): per-ClusterQueue nominal vs
+        used vs borrowed chips, pending depth, and admission-wait
+        percentiles. Empty when the cluster runs without quota scheduling."""
+        view = getattr(self.cluster.scheduler, "queues_view", None)
+        return [] if view is None else view()
 
     def models_view(self) -> list[dict]:
         """Registered models with stage holders (the model-registry UI
@@ -426,6 +434,7 @@ class DashboardServer(ThreadedAiohttpServer):
         app.router.add_get("/", index)
         app.router.add_get("/api/summary", handler(self.summary_view))
         app.router.add_get("/api/jobs", handler(self.jobs_view))
+        app.router.add_get("/api/queues", handler(self.queues_view))
         app.router.add_get("/api/profiles", handler(self.profiles_view))
         app.router.add_get("/api/notebooks", handler(self.notebooks_view))
         app.router.add_get("/api/tensorboards", handler(self.tensorboards_view))
@@ -508,7 +517,7 @@ _INDEX_HTML = """<!doctype html>
 <header><h1>kubeflow-tpu</h1><nav id="nav"></nav></header>
 <main id="main"></main>
 <script>
-const tabs=["summary","jobs","experiments","pipelines","models","notebooks","volumes","tensorboards","profiles"];
+const tabs=["summary","jobs","queues","experiments","pipelines","models","notebooks","volumes","tensorboards","profiles"];
 let tab="summary";
 const $=(h)=>{const d=document.createElement("div");d.innerHTML=h;return d};
 const esc=(s)=>String(s).replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
@@ -545,6 +554,14 @@ async function render(){nav();const m=document.getElementById("main");m.textCont
    table(rows,["name","kind","phase","chips","restarts","uid"],
     r=>`<button class="act" onclick="logs('${uenc(r.uid)}')">logs</button>
         <button class="act" onclick="del('/api/jobs/${uenc(r.uid)}')">delete</button>`)+`<pre id="logs" hidden></pre>`}
+ if(tab==="queues"){const chips=(d)=>Object.entries(d||{}).map(([g,c])=>`${g}:${c}`).join(" ")||"—";
+  const rows=(await j("/api/queues")).map(r=>({name:r.name,cohort:r.cohort||"—",
+   nominal:chips(r.nominal),used:chips(r.usage),borrowed:chips(r.borrowed),
+   limit:r.borrowing_limit??"∞",pending:r.pending,admitted:r.admitted,
+   "wait p50/p95":r.wait_p50_s==null?"—":`${r.wait_p50_s.toFixed(2)}s / ${r.wait_p95_s.toFixed(2)}s`,
+   localqueues:(r.local_queues||[]).join(", ")||"—"}));
+  m.innerHTML=`<div class="bar"><i>ClusterQueues: nominal quota, live usage, cohort borrowing, admission wait</i></div>`+
+   table(rows,["name","cohort","nominal","used","borrowed","limit","pending","admitted","wait p50/p95","localqueues"])}
  if(tab==="experiments"){const rows=(await j("/api/experiments")).map(r=>({...r,
    name:raw(`<a href="#" onclick="trials('${uenc(r.name)}');return false">${esc(r.name)}</a>`)}));
   m.innerHTML=table(rows,["name","trials","succeeded","failed","running"])+`<pre id="detail" hidden></pre>`}

@@ -252,6 +252,10 @@ def parse(manifest: Mapping[str, Any]) -> Any:
             {"name": manifest.get("metadata", {}).get("name"),
              **manifest.get("spec", {})}
         )
+    if kind in ("ClusterQueue", "LocalQueue"):
+        from kubeflow_tpu.sched import queues as sched_queues
+
+        return sched_queues.from_manifest(manifest)
     if kind == "PersistentVolumeClaim":
         from kubeflow_tpu.platform.volumes import VolumeSpec
 

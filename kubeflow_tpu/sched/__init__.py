@@ -1,0 +1,26 @@
+"""Multi-tenant quota scheduling — the Kueue analog.
+
+- ``queues``     — ClusterQueue (chip quota per generation, cohort,
+                   borrowing limit, preemption policy) + LocalQueue
+                   (tenant → ClusterQueue binding) + QueueConfig.
+- ``workload``   — the per-gang quota ledger entry (charged vs borrowed).
+- ``scheduler``  — QuotaScheduler: nominal admission, cohort borrowing with
+                   dominant-share fairness, preemption intents.
+- ``preemption`` — victim selection (borrowed-first, lowest-priority,
+                   newest-first) with quota+topology feasibility simulation.
+
+The eviction half runs in ``orchestrator.reconciler``: a victim is driven
+through the graceful preemption path built in the chaos work — SIGTERM →
+forced checkpoint → exit 143 → gang requeued ``Queued`` with claims
+released, ``reason=Preempted``, no backoff burned — and resumes at the
+exact next step when capacity returns.
+"""
+
+from kubeflow_tpu.sched.queues import (  # noqa: F401
+    ClusterQueue,
+    LocalQueue,
+    PreemptionPolicy,
+    QueueConfig,
+)
+from kubeflow_tpu.sched.scheduler import QuotaScheduler  # noqa: F401
+from kubeflow_tpu.sched.workload import Workload  # noqa: F401

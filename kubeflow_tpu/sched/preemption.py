@@ -1,0 +1,121 @@
+"""Victim selection: which admitted gangs a blocked workload may evict.
+
+A workload earns the right to preempt only when it is asking for capacity
+its ClusterQueue *owns* — it fits nominal quota once the victims are gone —
+and the capacity is currently held by cohort **borrowers** or (policy
+permitting) **lower-priority** workloads of its own queue. Eviction order
+is the Kueue/Borg convention: borrowed-first, then lowest-priority,
+newest-first — a borrower is living on someone else's quota, a newer
+workload has wasted the least work.
+
+Selection is a greedy simulation: walk candidates in eviction order,
+virtually release each victim's slice claims and quota charge, and stop at
+the first prefix that makes the preemptor feasible **both** ways — quota
+(nominal fits) and topology (``Fleet.fits_gang`` with the victims' chips
+returned). No feasible prefix ⇒ no preemption (never evict work that
+cannot actually be replaced by the preemptor).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.orchestrator.resources import Fleet
+from kubeflow_tpu.sched.workload import Workload
+
+logger = logging.getLogger(__name__)
+
+
+def _fits_nominal(
+    w: Workload, usage: dict[str, dict[str, int]]
+) -> bool:
+    cq = w.cluster_queue
+    if cq is None:
+        return False
+    used = usage.get(cq.name, {})
+    return all(
+        used.get(gen, 0) + chips <= cq.nominal(gen)
+        for gen, chips in w.chips_by_gen.items()
+    )
+
+
+def eviction_candidates(
+    preemptor: Workload, held: list[Workload]
+) -> list[Workload]:
+    """Admitted workloads the preemptor's policy allows it to evict, in
+    eviction order (borrowed-first, then lowest-priority, newest-first)."""
+    cq = preemptor.cluster_queue
+    if cq is None:
+        return []
+    policy = cq.preemption
+    ranked: list[tuple[int, Workload]] = []
+    for v in held:
+        if v.uid == preemptor.uid or v.cluster_queue is None:
+            continue
+        vcq = v.cluster_queue
+        same_queue = vcq.name == cq.name
+        same_cohort = (
+            cq.cohort is not None and vcq.cohort == cq.cohort
+        )
+        if not same_queue and same_cohort and v.borrowed_total > 0:
+            # a cohort borrower holding quota the preemptor owns
+            if policy.reclaim_within_cohort == "Never":
+                continue
+            if (
+                policy.reclaim_within_cohort == "LowerPriority"
+                and v.priority >= preemptor.priority
+            ):
+                continue
+            ranked.append((0, v))
+        elif same_queue:
+            if policy.within_cluster_queue == "Never":
+                continue
+            if v.priority >= preemptor.priority:
+                continue
+            ranked.append((1, v))
+    ranked.sort(
+        key=lambda t: (
+            t[0],                       # borrowers before own-queue victims
+            t[1].priority,              # lowest priority first
+            -(t[1].admitted_at or 0.0), # newest first
+        )
+    )
+    return [v for _, v in ranked]
+
+
+def plan_preemption(
+    preemptor: Workload,
+    held: list[Workload],
+    usage: dict[str, dict[str, int]],
+    fleet: Fleet,
+) -> list[Workload] | None:
+    """Minimal eviction-ordered victim prefix that makes ``preemptor``
+    feasible within its nominal quota, or None."""
+    candidates = eviction_candidates(preemptor, held)
+    if not candidates:
+        return None
+    requests = [
+        (chips, topo, gen)
+        for _, chips, topo, gen in preemptor.group.requests
+    ]
+    sim_usage = {q: dict(g) for q, g in usage.items()}
+    extra_free: dict[str, int] = {}
+    victims: list[Workload] = []
+    for v in candidates:
+        victims.append(v)
+        for claim in (v.group.claims or {}).values():
+            extra_free[claim.slice_id] = (
+                extra_free.get(claim.slice_id, 0) + claim.chips
+            )
+        vq = sim_usage.setdefault(v.cluster_queue.name, {})
+        for gen, chips in v.chips_by_gen.items():
+            vq[gen] = vq.get(gen, 0) - chips
+        if _fits_nominal(preemptor, sim_usage) and fleet.fits_gang(
+            requests, extra_free=extra_free
+        ):
+            logger.info(
+                "preemption planned: %s evicts %s",
+                preemptor.uid, [v.uid for v in victims],
+            )
+            return victims
+    return None

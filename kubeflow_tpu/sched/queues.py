@@ -1,0 +1,287 @@
+"""Queue objects: the Kueue ClusterQueue / LocalQueue analog.
+
+Kueue (the Kubeflow ecosystem's quota-admission layer, itself a descendant
+of Borg's quota-and-preemption scheduling) splits multi-tenant admission
+into two objects: a ``ClusterQueue`` owns capacity — nominal quota per
+resource flavor, cohort membership for borrowing, a preemption policy —
+and a ``LocalQueue`` is the namespaced tenant handle that binds job
+submissions to a ClusterQueue. This module is that data model, TPU-form:
+quota is **chips per accelerator generation** (the resource flavors of a
+TPU fleet), and both objects are declarable as YAML manifests alongside
+job specs (``platform.manifests.parse`` knows the kinds) or as plain
+dicts/dataclasses in code.
+
+Semantics implemented by ``sched.scheduler.QuotaScheduler``:
+
+- a workload is charged against its ClusterQueue's nominal quota;
+- queues in the same ``cohort`` may *borrow* each other's unused nominal
+  quota, up to ``borrowing_limit`` chips beyond their own nominal;
+- a workload that fits its **nominal** quota may *preempt* — reclaim
+  capacity held by cohort borrowers and, policy permitting, by
+  lower-priority workloads of its own ClusterQueue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Mapping
+
+#: ``reclaim_within_cohort`` values (who a nominal-quota workload may evict
+#: among cohort borrowers) and ``within_cluster_queue`` values (whether it
+#: may evict lower-priority workloads of its own queue).
+RECLAIM_POLICIES = ("Never", "LowerPriority", "Any")
+WITHIN_POLICIES = ("Never", "LowerPriority")
+
+
+@dataclasses.dataclass(frozen=True)
+class PreemptionPolicy:
+    """Who this queue's workloads may evict to reclaim nominal quota
+    (the Kueue ``ClusterQueue.spec.preemption`` analog)."""
+
+    #: cohort borrowers: Never | LowerPriority (only borrowers of lower
+    #: priority) | Any (any borrower — reclaiming nominal quota outranks
+    #: a borrower's priority, the Kueue ``reclaimWithinCohort: Any`` mode).
+    reclaim_within_cohort: str = "Any"
+    #: own queue: Never | LowerPriority.
+    within_cluster_queue: str = "LowerPriority"
+
+    def __post_init__(self) -> None:
+        if self.reclaim_within_cohort not in RECLAIM_POLICIES:
+            raise ValueError(
+                f"reclaim_within_cohort {self.reclaim_within_cohort!r} "
+                f"not in {RECLAIM_POLICIES}"
+            )
+        if self.within_cluster_queue not in WITHIN_POLICIES:
+            raise ValueError(
+                f"within_cluster_queue {self.within_cluster_queue!r} "
+                f"not in {WITHIN_POLICIES}"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "PreemptionPolicy":
+        return cls(
+            reclaim_within_cohort=d.get(
+                "reclaim_within_cohort", d.get("reclaimWithinCohort", "Any")
+            ),
+            within_cluster_queue=d.get(
+                "within_cluster_queue",
+                d.get("withinClusterQueue", "LowerPriority"),
+            ),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "reclaim_within_cohort": self.reclaim_within_cohort,
+            "within_cluster_queue": self.within_cluster_queue,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterQueue:
+    """Capacity owner: chip quota per accelerator generation.
+
+    ``quota`` maps generation → nominal chips ("v5e" → 16). ``cohort``
+    names the borrowing pool; None opts out of borrowing entirely.
+    ``borrowing_limit`` caps how many chips beyond nominal this queue may
+    hold per generation (None = unbounded within cohort headroom).
+    """
+
+    name: str
+    quota: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    cohort: str | None = None
+    borrowing_limit: int | None = None
+    preemption: PreemptionPolicy = dataclasses.field(
+        default_factory=PreemptionPolicy
+    )
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            raise ValueError("ClusterQueue needs a name")
+        for gen, chips in self.quota.items():
+            if int(chips) < 0:
+                raise ValueError(
+                    f"ClusterQueue {self.name}: negative quota for {gen!r}"
+                )
+        if self.borrowing_limit is not None and self.borrowing_limit < 0:
+            raise ValueError(
+                f"ClusterQueue {self.name}: negative borrowing_limit"
+            )
+        if self.borrowing_limit and self.cohort is None:
+            raise ValueError(
+                f"ClusterQueue {self.name}: borrowing_limit without a "
+                "cohort can never be used — set cohort or drop the limit"
+            )
+
+    def nominal(self, generation: str) -> int:
+        return int(self.quota.get(generation, 0))
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ClusterQueue":
+        return cls(
+            name=d["name"],
+            quota={k: int(v) for k, v in dict(d.get("quota", {})).items()},
+            cohort=d.get("cohort"),
+            borrowing_limit=(
+                int(d["borrowing_limit"])
+                if d.get("borrowing_limit") is not None
+                else None
+            ),
+            preemption=PreemptionPolicy.from_dict(d.get("preemption", {})),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "quota": dict(self.quota),
+            "cohort": self.cohort,
+            "borrowing_limit": self.borrowing_limit,
+            "preemption": self.preemption.to_dict(),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalQueue:
+    """Tenant handle: the name jobs submit to (``SchedulingPolicy.queue``),
+    bound to the ClusterQueue whose quota admits them."""
+
+    name: str
+    cluster_queue: str
+    namespace: str = "default"
+
+    def __post_init__(self) -> None:
+        if not self.name or not self.cluster_queue:
+            raise ValueError("LocalQueue needs name and cluster_queue")
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "LocalQueue":
+        return cls(
+            name=d["name"],
+            cluster_queue=d.get("cluster_queue", d.get("clusterQueue", "")),
+            namespace=d.get("namespace", "default"),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "cluster_queue": self.cluster_queue,
+            "namespace": self.namespace,
+        }
+
+
+class QueueConfig:
+    """Validated set of ClusterQueues + LocalQueues the scheduler runs on."""
+
+    def __init__(
+        self,
+        cluster_queues: Iterable[ClusterQueue] = (),
+        local_queues: Iterable[LocalQueue] = (),
+    ):
+        self.cluster_queues: dict[str, ClusterQueue] = {}
+        self.local_queues: dict[str, LocalQueue] = {}
+        for cq in cluster_queues:
+            self.add(cq)
+        for lq in local_queues:
+            self.add(lq)
+        self.validate()
+
+    def add(self, obj: ClusterQueue | LocalQueue) -> None:
+        if isinstance(obj, ClusterQueue):
+            if obj.name in self.cluster_queues:
+                raise ValueError(f"duplicate ClusterQueue {obj.name!r}")
+            self.cluster_queues[obj.name] = obj
+        elif isinstance(obj, LocalQueue):
+            if obj.name in self.local_queues:
+                raise ValueError(f"duplicate LocalQueue {obj.name!r}")
+            self.local_queues[obj.name] = obj
+        else:
+            raise TypeError(f"not a queue object: {obj!r}")
+
+    def validate(self) -> None:
+        for lq in self.local_queues.values():
+            if lq.cluster_queue not in self.cluster_queues:
+                raise ValueError(
+                    f"LocalQueue {lq.name!r} binds unknown ClusterQueue "
+                    f"{lq.cluster_queue!r} (known: "
+                    f"{sorted(self.cluster_queues)})"
+                )
+
+    def resolve(self, local_queue: str) -> ClusterQueue | None:
+        """LocalQueue name → its ClusterQueue; None when unknown."""
+        lq = self.local_queues.get(local_queue)
+        if lq is None:
+            return None
+        return self.cluster_queues.get(lq.cluster_queue)
+
+    def cohort_members(self, cohort: str) -> list[ClusterQueue]:
+        return [
+            cq for cq in self.cluster_queues.values() if cq.cohort == cohort
+        ]
+
+    def local_queues_of(self, cq_name: str) -> list[str]:
+        return sorted(
+            lq.name
+            for lq in self.local_queues.values()
+            if lq.cluster_queue == cq_name
+        )
+
+    @classmethod
+    def from_specs(cls, specs: Iterable[Any]) -> "QueueConfig":
+        """Build from a mixed iterable of queue dataclasses and/or manifest
+        dicts (the shapes ``from_manifest`` accepts)."""
+        cqs: list[ClusterQueue] = []
+        lqs: list[LocalQueue] = []
+        for s in specs:
+            if isinstance(s, Mapping):
+                s = from_manifest(s)
+            if isinstance(s, ClusterQueue):
+                cqs.append(s)
+            elif isinstance(s, LocalQueue):
+                lqs.append(s)
+            else:
+                raise TypeError(f"not a queue spec: {s!r}")
+        return cls(cqs, lqs)
+
+
+def from_manifest(manifest: Mapping[str, Any]) -> ClusterQueue | LocalQueue:
+    """Parse a ClusterQueue/LocalQueue manifest (the Kueue CRD shapes,
+    TPU-form: ``spec.quota`` maps generation → chips)::
+
+        kind: ClusterQueue
+        metadata: {name: tenant-a}
+        spec:
+          cohort: shared
+          quota: {v5e: 8}
+          borrowingLimit: 4
+          preemption: {reclaimWithinCohort: Any,
+                       withinClusterQueue: LowerPriority}
+
+        kind: LocalQueue
+        metadata: {name: team-a, namespace: default}
+        spec: {clusterQueue: tenant-a}
+    """
+    kind = manifest.get("kind")
+    meta = manifest.get("metadata", {})
+    spec = manifest.get("spec", {}) or {}
+    if kind == "ClusterQueue":
+        return ClusterQueue.from_dict(
+            {
+                "name": meta.get("name", ""),
+                "quota": spec.get("quota", {}),
+                "cohort": spec.get("cohort"),
+                "borrowing_limit": spec.get(
+                    "borrowingLimit", spec.get("borrowing_limit")
+                ),
+                "preemption": spec.get("preemption", {}),
+            }
+        )
+    if kind == "LocalQueue":
+        return LocalQueue.from_dict(
+            {
+                "name": meta.get("name", ""),
+                "cluster_queue": spec.get(
+                    "clusterQueue", spec.get("cluster_queue", "")
+                ),
+                "namespace": meta.get("namespace", "default"),
+            }
+        )
+    raise ValueError(f"not a queue manifest kind: {kind!r}")

@@ -1,0 +1,409 @@
+"""QuotaScheduler: multi-tenant quota admission over the gang scheduler.
+
+The Kueue admission loop, TPU-form. ``GangScheduler`` gave each queue
+strict priority + FIFO over raw fleet capacity; this subclass makes the
+queue name a **LocalQueue** and admits against its **ClusterQueue**'s chip
+quota instead of raw capacity:
+
+1. **Nominal admission** — a workload whose ClusterQueue usage + demand
+   fits nominal quota admits first (per-queue priority+FIFO preserved; a
+   blocked head still holds its queue's line so large gangs never starve).
+2. **Borrowing** — a workload over nominal may borrow unused nominal quota
+   of other queues in the same ``cohort``, capped by ``borrowing_limit``.
+   Across queues, borrow-needing heads are served in dominant-resource-
+   share order (least-loaded queue first), so one tenant cannot starve a
+   cohort.
+3. **Preemption** — a workload that fits *nominal* quota but finds the
+   chips physically held by cohort borrowers or lower-priority own-queue
+   workloads selects victims (``sched.preemption``) and records intents;
+   the reconciler drives each victim through the graceful preemption path
+   (SIGTERM → forced checkpoint → exit 143 → gang requeued, no backoff
+   burned) and the preemptor admits once the claims free.
+
+Everything still rides ``Fleet.claim_gang`` — quota says *may* a workload
+run, topology-aware claims say *where*; admission requires both.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from kubeflow_tpu.obs import prom
+from kubeflow_tpu.orchestrator.gang import GangScheduler, PodGroup
+from kubeflow_tpu.orchestrator.resources import Fleet
+from kubeflow_tpu.sched.preemption import plan_preemption
+from kubeflow_tpu.sched.queues import ClusterQueue, QueueConfig
+from kubeflow_tpu.sched.workload import Workload, group_chips_by_generation
+
+logger = logging.getLogger(__name__)
+
+QUEUE_NOMINAL = prom.REGISTRY.gauge(
+    "kft_queue_nominal_chips",
+    "nominal chip quota per ClusterQueue and accelerator generation",
+    labels=("queue", "generation"),
+)
+QUEUE_BORROWED = prom.REGISTRY.gauge(
+    "kft_queue_borrowed_chips",
+    "chips each ClusterQueue currently holds beyond nominal (cohort-borrowed)",
+    labels=("queue", "generation"),
+)
+QUEUE_PENDING = prom.REGISTRY.gauge(
+    "kft_queue_pending_workloads",
+    "workloads waiting for quota admission per ClusterQueue",
+    labels=("queue",),
+)
+PREEMPTIONS = prom.REGISTRY.counter(
+    "kft_preemptions_total",
+    "workloads preempted by the quota scheduler",
+    labels=("reason",),
+)
+QUEUE_WAIT = prom.REGISTRY.histogram(
+    "kft_queue_wait_seconds",
+    "enqueue-to-admission wait per ClusterQueue",
+    labels=("queue",),
+)
+
+#: per-queue wait samples kept for exact p50/p95 in `kft queues show`
+_WAIT_SAMPLE_CAP = 512
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, round(q * (len(sorted_vals) - 1)))
+    return sorted_vals[idx]
+
+
+class QuotaScheduler(GangScheduler):
+    """Quota-aware admission in front of the gang scheduler."""
+
+    def __init__(
+        self,
+        fleet: Fleet,
+        config: QueueConfig,
+        *,
+        preemption_grace_seconds: float = 5.0,
+    ):
+        super().__init__(fleet)
+        config.validate()
+        self.config = config
+        #: SIGTERM-to-SIGKILL budget the reconciler gives a victim to take
+        #: its forced checkpoint before the hard kill.
+        self.preemption_grace_seconds = preemption_grace_seconds
+        #: job_uid → Workload for every pending or held gang.
+        self._workloads: dict[str, Workload] = {}
+        #: victim job_uid → preemptor job_uid (intents the reconciler drives)
+        self._preempting: dict[str, str] = {}
+        #: ClusterQueue name → recent enqueue→admission waits (seconds)
+        self._waits: dict[str, list[float]] = {}
+        # scrape-time gauge refresh (the client_golang Collector idiom)
+        prom.REGISTRY.add_collector(self._refresh_gauges, key=self)
+
+    def close(self) -> None:
+        prom.REGISTRY.remove_collector(self)
+
+    # -- queue lookups --------------------------------------------------- #
+
+    def knows_queue(self, local_queue: str) -> bool:
+        return local_queue in self.config.local_queues
+
+    def known_queues(self) -> list[str]:
+        return sorted(self.config.local_queues)
+
+    def preemption_requested(self, job_uid: str) -> bool:
+        with self._lock:
+            return job_uid in self._preempting
+
+    # -- bookkeeping overrides ------------------------------------------- #
+
+    def _wrap(self, group: PodGroup) -> Workload:
+        return Workload(
+            group=group,
+            cluster_queue=self.config.resolve(group.queue),
+            chips_by_gen=group_chips_by_generation(group),
+        )
+
+    def enqueue(self, group: PodGroup) -> None:
+        with self._lock:
+            if group.job_uid in self._pending or group.job_uid in self._held:
+                return
+            self._pending[group.job_uid] = group
+            w = self._wrap(group)
+            self._workloads[group.job_uid] = w
+            if w.cluster_queue is None:
+                logger.warning(
+                    "job %s submitted to unknown LocalQueue %r — it will "
+                    "never admit (known: %s)",
+                    group.job_uid, group.queue, self.known_queues(),
+                )
+
+    def cancel(self, job_uid: str) -> None:
+        with self._lock:
+            self._pending.pop(job_uid, None)
+            group = self._held.pop(job_uid, None)
+            self._workloads.pop(job_uid, None)
+            # a cancelled victim's intent is fulfilled (or moot); a
+            # cancelled preemptor must not keep evicting for capacity it
+            # will never use
+            self._preempting.pop(job_uid, None)
+            for victim, preemptor in list(self._preempting.items()):
+                if preemptor == job_uid:
+                    del self._preempting[victim]
+        if group and group.claims:
+            self.fleet.release(list(group.claims.values()))
+
+    def timed_out(self) -> list[PodGroup]:
+        out = super().timed_out()
+        if out:
+            with self._lock:
+                for g in out:
+                    self._workloads.pop(g.job_uid, None)
+        return out
+
+    # -- quota accounting (lock held) ------------------------------------ #
+
+    def _usage_locked(self) -> dict[str, dict[str, int]]:
+        """ClusterQueue name → generation → chips held by admitted gangs."""
+        usage: dict[str, dict[str, int]] = {}
+        for uid in self._held:
+            w = self._workloads.get(uid)
+            if w is None or w.cluster_queue is None:
+                continue
+            q = usage.setdefault(w.cluster_queue.name, {})
+            for gen, chips in w.chips_by_gen.items():
+                q[gen] = q.get(gen, 0) + chips
+        return usage
+
+    def _fits_quota_locked(
+        self,
+        w: Workload,
+        usage: dict[str, dict[str, int]],
+        *,
+        borrow: bool,
+    ) -> bool:
+        cq = w.cluster_queue
+        if cq is None:
+            return False
+        used = usage.get(cq.name, {})
+        for gen, chips in w.chips_by_gen.items():
+            new = used.get(gen, 0) + chips
+            nominal = cq.nominal(gen)
+            if new <= nominal:
+                continue
+            if not borrow or cq.cohort is None:
+                return False
+            if (
+                cq.borrowing_limit is not None
+                and new - nominal > cq.borrowing_limit
+            ):
+                return False
+            members = self.config.cohort_members(cq.cohort)
+            cohort_nominal = sum(m.nominal(gen) for m in members)
+            cohort_used = sum(
+                usage.get(m.name, {}).get(gen, 0) for m in members
+            )
+            if cohort_used + chips > cohort_nominal:
+                return False
+        return True
+
+    def _dominant_share_locked(
+        self, cq: ClusterQueue, usage: dict[str, dict[str, int]]
+    ) -> float:
+        """Max over generations of usage/nominal — the DRF ordering key for
+        cohort borrowing (zero-nominal generations with any usage count as
+        fully saturated)."""
+        used = usage.get(cq.name, {})
+        share = 0.0
+        for gen, chips in used.items():
+            nominal = cq.nominal(gen)
+            if nominal > 0:
+                share = max(share, chips / nominal)
+            elif chips > 0:
+                share = max(share, float("inf"))
+        return share
+
+    # -- admission -------------------------------------------------------- #
+
+    def try_schedule(self) -> list[PodGroup]:
+        """One quota-admission pass; returns newly admitted groups."""
+        admitted: list[PodGroup] = []
+        now = time.time()
+        with self._lock:
+            usage = self._usage_locked()
+            blocked: set[str] = set()
+            progress = True
+            while progress:
+                progress = False
+                for w in self._heads_locked(usage, blocked):
+                    uid = w.uid
+                    cq = w.cluster_queue
+                    if uid in set(self._preempting.values()):
+                        # victims are still draining for this workload;
+                        # hold its queue's line until the claims free
+                        blocked.add(cq.name)
+                        continue
+                    fits_nominal = self._fits_quota_locked(
+                        w, usage, borrow=False
+                    )
+                    fits = fits_nominal or self._fits_quota_locked(
+                        w, usage, borrow=True
+                    )
+                    if fits and self._admit_locked(w.group):
+                        self._charge_locked(w, usage, now)
+                        admitted.append(w.group)
+                        progress = True
+                        continue
+                    if fits_nominal:
+                        # quota says yes, capacity says no: the chips are
+                        # physically held by borrowers or lower-priority
+                        # workloads — reclaim them
+                        self._plan_preemption_locked(w, usage)
+                    blocked.add(cq.name)  # head-of-line holds the queue
+        return admitted
+
+    def _heads_locked(
+        self, usage: dict[str, dict[str, int]], blocked: set[str]
+    ) -> list[Workload]:
+        """Head workload of each unblocked ClusterQueue, ordered: nominal-
+        fitting heads first (FIFO among them), then borrow-needing heads by
+        dominant share (fair sharing across the cohort)."""
+        by_cq: dict[str, list[Workload]] = {}
+        for uid in self._pending:
+            w = self._workloads.get(uid)
+            if w is None or w.cluster_queue is None:
+                continue
+            if w.cluster_queue.name in blocked:
+                continue
+            by_cq.setdefault(w.cluster_queue.name, []).append(w)
+        heads = []
+        for workloads in by_cq.values():
+            workloads.sort(
+                key=lambda w: (-w.priority, w.group.enqueued_at)
+            )
+            heads.append(workloads[0])
+        heads.sort(
+            key=lambda w: (
+                0 if self._fits_quota_locked(w, usage, borrow=False) else 1,
+                self._dominant_share_locked(w.cluster_queue, usage),
+                w.group.enqueued_at,
+            )
+        )
+        return heads
+
+    def _charge_locked(
+        self,
+        w: Workload,
+        usage: dict[str, dict[str, int]],
+        now: float,
+    ) -> None:
+        """Record an admission: update usage, split nominal vs borrowed,
+        and observe the queue wait."""
+        cq = w.cluster_queue
+        used = usage.setdefault(cq.name, {})
+        borrowed: dict[str, int] = {}
+        for gen, chips in w.chips_by_gen.items():
+            before = used.get(gen, 0)
+            after = before + chips
+            nominal = cq.nominal(gen)
+            over = max(0, after - nominal) - max(0, before - nominal)
+            if over:
+                borrowed[gen] = over
+            used[gen] = after
+        w.borrowed = borrowed
+        w.admitted_at = now
+        wait = max(0.0, now - w.group.enqueued_at)
+        QUEUE_WAIT.labels(queue=cq.name).observe(wait)
+        samples = self._waits.setdefault(cq.name, [])
+        samples.append(wait)
+        if len(samples) > _WAIT_SAMPLE_CAP:
+            del samples[: len(samples) - _WAIT_SAMPLE_CAP]
+
+    def _plan_preemption_locked(
+        self, w: Workload, usage: dict[str, dict[str, int]]
+    ) -> None:
+        held = [
+            self._workloads[uid]
+            for uid in self._held
+            if uid in self._workloads
+            # a gang already marked for eviction is spoken for
+            and uid not in self._preempting
+        ]
+        victims = plan_preemption(w, held, usage, self.fleet)
+        if not victims:
+            return
+        for v in victims:
+            self._preempting[v.uid] = w.uid
+            reason = "borrowed" if v.borrowed_total > 0 else "priority"
+            PREEMPTIONS.labels(reason=reason).inc()
+            logger.warning(
+                "preempting %s (queue %s, %s) so %s reclaims nominal quota",
+                v.uid, v.group.queue, reason, w.uid,
+            )
+
+    # -- observability ---------------------------------------------------- #
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            usage = self._usage_locked()
+            borrowed: dict[str, dict[str, int]] = {}
+            pending: dict[str, int] = {}
+            for uid, w in self._workloads.items():
+                if w.cluster_queue is None:
+                    continue
+                name = w.cluster_queue.name
+                if uid in self._held:
+                    b = borrowed.setdefault(name, {})
+                    for gen, chips in w.borrowed.items():
+                        b[gen] = b.get(gen, 0) + chips
+                elif uid in self._pending:
+                    pending[name] = pending.get(name, 0) + 1
+        for cq in self.config.cluster_queues.values():
+            gens = set(cq.quota) | set(usage.get(cq.name, {}))
+            for gen in gens:
+                QUEUE_NOMINAL.labels(
+                    queue=cq.name, generation=gen
+                ).set(cq.nominal(gen))
+                QUEUE_BORROWED.labels(queue=cq.name, generation=gen).set(
+                    borrowed.get(cq.name, {}).get(gen, 0)
+                )
+            QUEUE_PENDING.labels(queue=cq.name).set(
+                pending.get(cq.name, 0)
+            )
+
+    def queues_view(self) -> list[dict]:
+        """Dashboard/CLI rows: per-ClusterQueue quota, live usage, borrow
+        split, pending depth, and enqueue→admission wait percentiles."""
+        with self._lock:
+            usage = self._usage_locked()
+            rows = []
+            for cq in self.config.cluster_queues.values():
+                borrowed: dict[str, int] = {}
+                admitted = pending = 0
+                for uid, w in self._workloads.items():
+                    if w.cluster_queue is not cq:
+                        continue
+                    if uid in self._held:
+                        admitted += 1
+                        for gen, chips in w.borrowed.items():
+                            borrowed[gen] = borrowed.get(gen, 0) + chips
+                    elif uid in self._pending:
+                        pending += 1
+                waits = sorted(self._waits.get(cq.name, []))
+                rows.append(
+                    {
+                        "name": cq.name,
+                        "cohort": cq.cohort,
+                        "nominal": dict(cq.quota),
+                        "usage": dict(usage.get(cq.name, {})),
+                        "borrowed": borrowed,
+                        "borrowing_limit": cq.borrowing_limit,
+                        "preemption": cq.preemption.to_dict(),
+                        "local_queues": self.config.local_queues_of(cq.name),
+                        "admitted": admitted,
+                        "pending": pending,
+                        "wait_p50_s": _percentile(waits, 0.50),
+                        "wait_p95_s": _percentile(waits, 0.95),
+                    }
+                )
+        return rows

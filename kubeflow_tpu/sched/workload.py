@@ -1,0 +1,70 @@
+"""Workload: one gang's quota ledger entry.
+
+The Kueue ``Workload`` analog: when a job's PodGroup enters the quota
+scheduler it is wrapped in a ``Workload`` that resolves the submission's
+LocalQueue to its ClusterQueue and aggregates the gang's chip demand per
+accelerator generation. While admitted, the workload records how many of
+those chips were charged *within* the ClusterQueue's nominal quota and how
+many were **borrowed** from the cohort — the split preemption keys off
+(borrowers are first in line to be reclaimed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kubeflow_tpu.orchestrator.gang import PodGroup
+from kubeflow_tpu.orchestrator.resources import topology_chips
+from kubeflow_tpu.sched.queues import ClusterQueue
+
+
+def group_chips_by_generation(group: PodGroup) -> dict[str, int]:
+    """Aggregate a gang's chip demand per generation; whole-slice topology
+    requests charge the full slice."""
+    out: dict[str, int] = {}
+    for _, chips, topo, gen in group.requests:
+        need = topology_chips(topo) if topo is not None else chips
+        out[gen] = out.get(gen, 0) + need
+    return out
+
+
+@dataclasses.dataclass
+class Workload:
+    """One gang under quota management (pending or admitted)."""
+
+    group: PodGroup
+    #: the ClusterQueue whose quota admits this workload; None when the
+    #: submission named an unknown LocalQueue (never admitted — the
+    #: admission webhook normally rejects this before it gets here).
+    cluster_queue: ClusterQueue | None
+    #: generation → chips the whole gang occupies.
+    chips_by_gen: dict[str, int] = dataclasses.field(default_factory=dict)
+    #: generation → chips charged beyond nominal quota at admission time
+    #: (cohort-borrowed); empty while pending or when fully nominal.
+    borrowed: dict[str, int] = dataclasses.field(default_factory=dict)
+    admitted_at: float | None = None
+
+    @property
+    def uid(self) -> str:
+        return self.group.job_uid
+
+    @property
+    def priority(self) -> int:
+        return self.group.priority
+
+    @property
+    def borrowed_total(self) -> int:
+        return sum(self.borrowed.values())
+
+    def to_dict(self) -> dict:
+        return {
+            "uid": self.uid,
+            "queue": self.group.queue,
+            "cluster_queue": (
+                self.cluster_queue.name if self.cluster_queue else None
+            ),
+            "priority": self.priority,
+            "chips": dict(self.chips_by_gen),
+            "borrowed": dict(self.borrowed),
+            "admitted": self.group.admitted,
+        }

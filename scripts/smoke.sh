@@ -144,4 +144,80 @@ print(f"kill-and-resume OK: preempted run exited 143, resumed at "
       f"step {resume + 1}, finished 8")
 EOF
 
+echo "== quota scheduler: cohort borrow -> preempt -> resume =="
+python - <<'EOF'
+import sys, tempfile, time
+
+from kubeflow_tpu.obs.prom import REGISTRY
+from kubeflow_tpu.orchestrator import (
+    JobSpec, LocalCluster, ReplicaSpec, RestartPolicy, RunPolicy,
+    SchedulingPolicy, TPURequest,
+)
+from kubeflow_tpu.orchestrator.resources import Fleet
+from kubeflow_tpu.sched import ClusterQueue, LocalQueue, QueueConfig
+
+
+def counter(name, **labels):
+    metric = REGISTRY._metrics.get(name)
+    child = metric._children.get(tuple(sorted(labels.items()))) if metric else None
+    return child.value if child else 0.0
+
+
+# tenant-b owns no quota and borrows tenant-a's; exits 143 on SIGTERM
+# (the trainer preemption protocol) and finishes clean after the requeue
+PREEMPTIBLE = (
+    "import os, signal, sys, time;"
+    "signal.signal(signal.SIGTERM, lambda *a: sys.exit(143));"
+    "time.sleep(30.0 if os.environ['KFT_ATTEMPT'] == '0' else 0.05);"
+    "sys.exit(0)"
+)
+config = QueueConfig(
+    [ClusterQueue("tenant-a", {"v5e": 4}, cohort="shared"),
+     ClusterQueue("tenant-b", {"v5e": 0}, cohort="shared",
+                  borrowing_limit=4)],
+    [LocalQueue("team-a", "tenant-a"), LocalQueue("team-b", "tenant-b")],
+)
+
+
+def job(name, queue, code, chips=4):
+    return JobSpec(
+        name=name,
+        replicas={"worker": ReplicaSpec(
+            replicas=1, command=(sys.executable, "-c", code),
+            restart_policy=RestartPolicy.EXIT_CODE,
+            tpu=TPURequest(chips=chips),
+        )},
+        run_policy=RunPolicy(scheduling=SchedulingPolicy(queue=queue)),
+    )
+
+
+p0 = counter("kft_preemptions_total", reason="borrowed")
+r0 = counter("kft_gang_requeues_total", reason="Preempted")
+with LocalCluster(
+    fleet=Fleet.homogeneous(1, "2x2"),
+    base_dir=tempfile.mkdtemp(prefix="kft-smoke-quota-"),
+    queues=config, resync_period=0.05, preemption_grace_seconds=10.0,
+) as cluster:
+    b_uid = cluster.submit(job("borrower", "team-b", PREEMPTIBLE))
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = cluster.status(b_uid)
+        if st and st.phase == "Running":
+            break
+        time.sleep(0.02)
+    assert cluster.status(b_uid).phase == "Running", "borrower never started"
+    # tenant-a reclaims its nominal quota -> tenant-b's borrower preempted
+    a_uid = cluster.submit(
+        job("reclaimer", "team-a", "import time; time.sleep(0.3)")
+    )
+    assert cluster.wait(a_uid, timeout=60).phase == "Succeeded"
+    b_status = cluster.wait(b_uid, timeout=60)
+    assert b_status.phase == "Succeeded", b_status.phase  # resumed + finished
+    assert b_status.restart_count == 0, "preemption burned backoff budget"
+assert counter("kft_preemptions_total", reason="borrowed") == p0 + 1
+assert counter("kft_gang_requeues_total", reason="Preempted") == r0 + 1
+print("quota preempt OK: borrower evicted (143), reclaimer ran, "
+      "borrower resumed; kft_preemptions_total asserted")
+EOF
+
 echo "smoke OK"
