@@ -1,0 +1,34 @@
+"""``kft lint`` — repo-native AST static analysis.
+
+The reference stack ships correctness tooling alongside the code: Go
+controllers run ``go vet`` + ThreadSanitizer-adjacent race checks in
+presubmit, and Kueue/training-operator gate every PR on repo-specific
+linters. This package is that layer for the TPU platform: an AST-walking
+engine (:mod:`.engine`) plus passes (:mod:`.passes`) that machine-check the
+invariants this codebase discovered the hard way — lock discipline around
+background threads, a single definition site for every ``kft_*`` metric
+name, no device syncs on the training/serving hot loops, thread + clock
+hygiene, and seedable randomness in the chaos/sched planes.
+
+Suppressions are inline (``# kft: noqa[RULE]``) and must carry the
+invariant that makes the flagged line safe; legacy findings are pinned in
+``lint_baseline.json`` so new ones fail while the baseline burns down.
+"""
+
+from kubeflow_tpu.analysis.engine import (
+    Finding,
+    LintConfig,
+    LintResult,
+    load_config,
+    run_lint,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "LintResult",
+    "load_config",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,479 @@
+"""Lint engine: file discovery, pass dispatch, suppressions, baseline.
+
+The engine is deliberately small — all domain knowledge lives in the
+passes (:mod:`kubeflow_tpu.analysis.passes`). What the engine owns:
+
+- **discovery** — walk the configured include roots for ``*.py`` files,
+  minus exclude globs;
+- **dispatch** — parse each file once, hand the ``FileContext`` to every
+  enabled pass (``check``), then collect cross-file findings (``finish``);
+- **scoping** — rules listed in ``LintConfig.scopes`` only apply to their
+  configured paths (e.g. the JAX sync lint only patrols the hot-loop files);
+- **suppression** — ``# kft: noqa[rule]`` (or bare ``# kft: noqa``) on the
+  finding's line; policy requires the comment to state the invariant that
+  makes the line safe;
+- **baseline** — ``lint_baseline.json`` pins legacy findings by
+  ``(rule, path, message)`` fingerprint (no line numbers, so unrelated
+  edits don't shake the pin loose) while anything new fails the run.
+
+Config comes from ``[tool.kft-lint]`` in ``pyproject.toml``; Python 3.10
+has no ``tomllib``, so a minimal single-line-value parser covers the
+subset this table uses when the stdlib module is absent.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+from collections import Counter
+from typing import Iterable, Sequence
+
+NOQA_RE = re.compile(
+    r"#\s*kft:\s*noqa(?:\[(?P<rules>[A-Za-z0-9_,\- ]+)\])?"
+)
+
+SEVERITIES = ("warning", "error")
+
+#: Default per-rule path scoping (overridable via [tool.kft-lint.scopes]).
+#: A rule absent from this map applies everywhere.
+DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
+    # PR 2's hard-won hot-loop rules: these files must never sync the
+    # device on the loop thread nor donate trees they don't own.
+    "jax-sync": (
+        "kubeflow_tpu/train/loop.py",
+        "kubeflow_tpu/train/prefetch.py",
+        "kubeflow_tpu/serve/engine.py",
+    ),
+    # Supervision clocks must survive wall-clock jumps (NTP step, VM
+    # migration): grace/staleness/progress math is monotonic-only here.
+    "monotonic-clock": (
+        "kubeflow_tpu/obs/heartbeat.py",
+        "kubeflow_tpu/orchestrator/supervisor.py",
+        "kubeflow_tpu/platform/notebooks.py",
+    ),
+    # Both planes are contractually seedable (FaultPlan.seed, jitter_seed).
+    "unseeded-random": (
+        "kubeflow_tpu/chaos",
+        "kubeflow_tpu/sched",
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``fingerprint`` intentionally omits the line number:
+    baselines must survive unrelated edits above the pinned site."""
+
+    rule: str
+    path: str
+    line: int
+    severity: str
+    message: str
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.severity}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass
+class FileContext:
+    """One parsed file as every pass sees it."""
+
+    path: str  # repo-relative, forward slashes
+    source: str
+    tree: ast.Module
+    lines: list[str]
+
+
+class LintPass:
+    """Base class: per-file ``check`` + cross-file ``finish``."""
+
+    name = "abstract"
+    rules: tuple[str, ...] = ()
+
+    def begin(self, config: "LintConfig") -> None:  # pragma: no cover
+        pass
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        return []
+
+    def finish(self) -> list[Finding]:
+        return []
+
+
+def default_passes() -> list[LintPass]:
+    from kubeflow_tpu.analysis.passes import (
+        jaxsync,
+        locks,
+        metricnames,
+        randomness,
+        threads,
+    )
+
+    return [
+        locks.LockDisciplinePass(),
+        metricnames.MetricRegistryPass(),
+        jaxsync.JaxSyncPass(),
+        threads.ThreadHygienePass(),
+        randomness.RandomnessPass(),
+    ]
+
+
+def all_rules(passes: Iterable[LintPass] | None = None) -> tuple[str, ...]:
+    out: list[str] = []
+    for p in passes or default_passes():
+        out.extend(p.rules)
+    return tuple(out)
+
+
+@dataclasses.dataclass
+class LintConfig:
+    root: str = "."
+    include: tuple[str, ...] = ("kubeflow_tpu",)
+    exclude: tuple[str, ...] = ()
+    #: None → every registered rule.
+    rules: tuple[str, ...] | None = None
+    #: repo-relative path, or None to disable baselining.
+    baseline: str | None = "lint_baseline.json"
+    scopes: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=lambda: {k: v for k, v in DEFAULT_SCOPES.items()}
+    )
+
+
+def _mini_toml_table(path: str, table: str) -> dict:
+    """Fallback ``[table]`` reader for Python 3.10 (no tomllib): handles
+    ``key = "str"`` and (possibly multi-line) ``key = ["a", "b"]`` string
+    arrays — the only shapes ``[tool.kft-lint]`` uses. Sub-tables become
+    nested dicts. TOML's string-array syntax is valid Python literal
+    syntax, so values parse via ``ast.literal_eval`` once comment lines
+    are stripped."""
+    out: dict = {}
+    current: dict | None = None
+    try:
+        text = open(path, encoding="utf-8").read()
+    except OSError:
+        return out
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            header = line.strip("[]").strip().strip('"')
+            if header == table:
+                current = out
+            elif header.startswith(table + "."):
+                current = out.setdefault(header[len(table) + 1 :], {})
+            else:
+                current = None
+            continue
+        if current is None or "=" not in line:
+            continue
+        def strip_comment(s: str) -> str:
+            # safe when the part before '#' has balanced quotes (no '#'
+            # inside a string — true for every shape this table uses)
+            before = s.split("#", 1)[0]
+            if s != before and before.count('"') % 2 == 0:
+                return before.strip()
+            return s
+
+        key, _, value = line.partition("=")
+        value = strip_comment(value.strip())
+        # multi-line array: accumulate until the brackets balance
+        while value.count("[") > value.count("]") and i < len(lines):
+            cont = strip_comment(lines[i].strip())
+            i += 1
+            value += " " + cont
+        try:
+            current[key.strip().strip('"')] = ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            continue
+    return out
+
+
+def _pyproject_table(root: str) -> dict:
+    path = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(path):
+        return {}
+    try:
+        import tomllib  # Python >= 3.11
+    except ModuleNotFoundError:
+        return _mini_toml_table(path, "tool.kft-lint")
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    return data.get("tool", {}).get("kft-lint", {})
+
+
+def load_config(root: str = ".") -> LintConfig:
+    """LintConfig from ``[tool.kft-lint]`` (defaults where absent)."""
+    table = _pyproject_table(root)
+    cfg = LintConfig(root=root)
+    if "include" in table:
+        cfg.include = tuple(table["include"])
+    if "exclude" in table:
+        cfg.exclude = tuple(table["exclude"])
+    if "rules" in table:
+        cfg.rules = tuple(table["rules"])
+    if "baseline" in table:
+        cfg.baseline = table["baseline"] or None
+    scopes = table.get("scopes", {})
+    if isinstance(scopes, dict):
+        for rule, paths in scopes.items():
+            cfg.scopes[rule] = tuple(paths)
+    return cfg
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]
+    files: int
+    enabled_rules: tuple[str, ...]
+    baseline_matched: int = 0
+    noqa_suppressed: int = 0
+    #: baseline entries nothing matched this run — prune them.
+    stale_baseline: list[tuple[str, str, str]] = dataclasses.field(
+        default_factory=list
+    )
+    parse_errors: list[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "files": self.files,
+            "rules": list(self.enabled_rules),
+            "findings": [f.to_dict() for f in self.findings],
+            "baseline_matched": self.baseline_matched,
+            "noqa_suppressed": self.noqa_suppressed,
+            "stale_baseline": [list(fp) for fp in self.stale_baseline],
+            "parse_errors": list(self.parse_errors),
+        }
+
+
+def discover_files(config: LintConfig, paths: Sequence[str] | None = None) -> list[str]:
+    """Repo-relative ``*.py`` paths under the include roots (or explicit
+    ``paths``), minus exclude globs, sorted for deterministic output."""
+    roots = [os.path.normpath(p) for p in (paths or config.include)]
+    out: set[str] = set()
+    for rel in roots:
+        full = os.path.join(config.root, rel)
+        if os.path.isfile(full) and rel.endswith(".py"):
+            out.add(rel.replace(os.sep, "/"))
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                relpath = os.path.relpath(
+                    os.path.join(dirpath, fn), config.root
+                ).replace(os.sep, "/")
+                out.add(relpath)
+    def excluded(p: str) -> bool:
+        return any(
+            fnmatch.fnmatch(p, pat) or p.startswith(pat.rstrip("/") + "/")
+            for pat in config.exclude
+        )
+    return sorted(p for p in out if not excluded(p))
+
+
+def _in_scope(path: str, scope: tuple[str, ...] | None) -> bool:
+    if scope is None:
+        return True
+    return any(
+        path == entry or path.startswith(entry.rstrip("/") + "/")
+        for entry in scope
+    )
+
+
+def _noqa_rules(line: str) -> set[str] | None:
+    """None → no noqa; empty set → blanket noqa; else the named rules."""
+    m = NOQA_RE.search(line)
+    if m is None:
+        return None
+    rules = m.group("rules")
+    if not rules:
+        return set()
+    return {r.strip() for r in rules.split(",") if r.strip()}
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file → fingerprint multiset. Missing file → empty."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError:
+        return Counter()
+    entries = doc.get("findings", doc) if isinstance(doc, dict) else doc
+    out: Counter = Counter()
+    for e in entries:
+        out[(e["rule"], e["path"], e["message"])] += 1
+    return out
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    doc = {
+        "version": 1,
+        "comment": (
+            "Pinned legacy lint findings — new findings fail `kft lint`. "
+            "Burn this file down; never grow it."
+        ),
+        "findings": [
+            {"rule": f.rule, "path": f.path, "message": f.message}
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def run_lint(
+    config: LintConfig | None = None,
+    *,
+    rules: Sequence[str] | None = None,
+    paths: Sequence[str] | None = None,
+    baseline: bool = True,
+) -> LintResult:
+    """One full lint run. ``rules`` narrows to specific rule ids;
+    ``paths`` narrows discovery; ``baseline=False`` ignores the pin file
+    (what ``--no-baseline`` and baseline regeneration use)."""
+    config = config or load_config()
+    passes = default_passes()
+    known = set(all_rules(passes))
+    enabled = set(config.rules) if config.rules is not None else set(known)
+    if rules is not None:
+        unknown = sorted(set(rules) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {unknown}; known: {sorted(known)}"
+            )
+        enabled &= set(rules)
+    active = [p for p in passes if enabled & set(p.rules)]
+
+    files = discover_files(config, paths)
+    raw: list[Finding] = []
+    lines_by_path: dict[str, list[str]] = {}
+    parse_errors: list[str] = []
+    for p in active:
+        p.begin(config)
+    for rel in files:
+        full = os.path.join(config.root, rel)
+        try:
+            source = open(full, encoding="utf-8").read()
+            tree = ast.parse(source, filename=rel)
+        except (OSError, SyntaxError) as e:
+            parse_errors.append(f"{rel}: {e}")
+            continue
+        ctx = FileContext(
+            path=rel, source=source, tree=tree, lines=source.splitlines()
+        )
+        lines_by_path[rel] = ctx.lines
+        for p in active:
+            raw.extend(p.check(ctx))
+    for p in active:
+        raw.extend(p.finish())
+
+    # rule enablement + scope
+    raw = [
+        f
+        for f in raw
+        if f.rule in enabled and _in_scope(f.path, config.scopes.get(f.rule))
+    ]
+
+    # inline noqa suppression
+    kept: list[Finding] = []
+    noqa_suppressed = 0
+    for f in raw:
+        lines = lines_by_path.get(f.path, ())
+        line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        suppress = _noqa_rules(line)
+        if suppress is not None and (not suppress or f.rule in suppress):
+            noqa_suppressed += 1
+            continue
+        kept.append(f)
+
+    # baseline pinning
+    baseline_matched = 0
+    stale: list[tuple[str, str, str]] = []
+    if baseline and config.baseline:
+        pins = load_baseline(os.path.join(config.root, config.baseline))
+        unpinned: list[Finding] = []
+        for f in sorted(kept, key=lambda f: (f.path, f.line)):
+            if pins.get(f.fingerprint(), 0) > 0:
+                pins[f.fingerprint()] -= 1
+                baseline_matched += 1
+            else:
+                unpinned.append(f)
+        kept = unpinned
+        stale = sorted(fp for fp, n in pins.items() if n > 0)
+
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(
+        findings=kept,
+        files=len(files),
+        enabled_rules=tuple(sorted(enabled)),
+        baseline_matched=baseline_matched,
+        noqa_suppressed=noqa_suppressed,
+        stale_baseline=stale,
+        parse_errors=parse_errors,
+    )
+
+
+# --------------------------------------------------------------------- #
+# shared AST helpers the passes lean on
+# --------------------------------------------------------------------- #
+
+
+def is_self_attr(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def call_name(func: ast.AST) -> str | None:
+    """Dotted name of a call target: ``threading.Thread`` → that string,
+    bare ``Thread`` → ``"Thread"``; anything dynamic → None."""
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_docstring(tree: ast.Module, node: ast.Constant) -> bool:
+    """True when ``node`` is the docstring constant of the module or of
+    any class/function in it."""
+    for parent in ast.walk(tree):
+        if isinstance(
+            parent,
+            (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+        ):
+            body = parent.body
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and body[0].value is node
+            ):
+                return True
+    return False

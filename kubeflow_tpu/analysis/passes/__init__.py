@@ -1,0 +1,10 @@
+"""Lint passes — each module owns one repo-specific invariant family.
+
+- :mod:`.locks`       — ``lock-discipline``: guarded state mutated bare
+- :mod:`.metricnames` — ``metric-registry``: one definition site + kind/
+  label coherence for every ``kft_*``/``kubeflow_tpu_*`` metric name
+- :mod:`.jaxsync`     — ``jax-sync``: no device syncs / foreign donation
+  on the training and serving hot loops
+- :mod:`.threads`     — ``thread-join`` + ``monotonic-clock``
+- :mod:`.randomness`  — ``unseeded-random`` in the seedable planes
+"""

@@ -1,0 +1,90 @@
+"""``jax-sync`` — hot-loop device-sync and donation lint.
+
+PR 2's overlap work bought its throughput with two rules that nothing
+but review enforced until now:
+
+- the loop/step threads must never force a device sync:
+  ``jax.block_until_ready``, ``.item()``, and ``np.asarray`` on device
+  values all drain dispatch and serialize the pipeline. The one
+  sanctioned sync (the metric drain's single-leaf host transfer) carries
+  a ``# kft: noqa[jax-sync]`` stating why it is safe;
+- ``donate_argnums`` may only donate trees the step owns. Donating an
+  Orbax-restored tree corrupts the heap on this jaxlib (CPU backend
+  aliases restore buffers) — every donation site must either be
+  provably fit-owned (and say so in its noqa) or go through the
+  non-donating re-homing identity first.
+
+Scoped (``[tool.kft-lint].scopes``) to the hot-loop files:
+``train/loop.py``, ``train/prefetch.py``, ``serve/engine.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.engine import FileContext, Finding, LintPass
+
+RULE = "jax-sync"
+
+
+class JaxSyncPass(LintPass):
+    name = "jaxsync"
+    rules = (RULE,)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+
+        def flag(node: ast.AST, message: str) -> None:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=ctx.path,
+                    line=node.lineno,
+                    severity="error",
+                    message=message,
+                )
+            )
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "block_until_ready":
+                    flag(
+                        node,
+                        "block_until_ready forces a device sync on the hot "
+                        "path (and corrupts the heap after a donated Orbax "
+                        "restore on this jaxlib); sync via a host transfer "
+                        "off the loop thread instead",
+                    )
+                elif func.attr == "item" and not node.args and not node.keywords:
+                    flag(
+                        node,
+                        ".item() blocks the calling thread on device "
+                        "compute; convert on the metric-drain thread via "
+                        "a host transfer instead",
+                    )
+                elif (
+                    func.attr == "asarray"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in ("np", "numpy")
+                ):
+                    flag(
+                        node,
+                        "np.asarray on a device value is a blocking D2H "
+                        "sync; keep it off the loop thread (or noqa with "
+                        "the invariant that proves the operand is "
+                        "host-resident)",
+                    )
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums":
+                    flag(
+                        node,
+                        "donate_argnums: donated trees must be owned by "
+                        "this step — donating an Orbax-restored tree "
+                        "corrupts the heap; re-home restored state through "
+                        "the non-donating identity first (noqa with the "
+                        "ownership invariant once proven)",
+                    )
+        return findings

@@ -1,0 +1,247 @@
+"""``lock-discipline`` — the ThreadSanitizer-shaped race detector.
+
+For every class that owns a ``threading.Lock``/``RLock`` attribute, an
+attribute is *guarded* once any method mutates it inside
+``with self.<lock>:``. The invariant is then all-or-nothing: every other
+mutation of that attribute must also hold a lock. A bare mutation is a
+candidate race — and a near-certain one when it happens in a method that
+some ``threading.Thread(target=self.<m>)`` spawn uses as an entry point.
+
+Repo conventions honored:
+
+- ``__init__`` mutations are construction (single-threaded by contract);
+- methods named ``*_locked`` document "caller holds the lock" (the
+  ``_admit_locked``/``_plan_locked``/``_usage_locked`` idiom) and are
+  treated as locked context;
+- single-writer fields that are deliberately lock-free must carry
+  ``# kft: noqa[lock-discipline]`` plus a one-line invariant comment.
+
+Reads are only reported in thread-entry methods: a bare read elsewhere is
+usually a caller-synchronized snapshot, but a thread entry point reading
+guarded state without the lock races the writers by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from kubeflow_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    LintPass,
+    call_name,
+    is_self_attr,
+)
+
+RULE = "lock-discipline"
+
+#: receiver-method names that mutate common containers in place
+MUTATORS = {
+    "append", "add", "insert", "extend", "appendleft", "extendleft",
+    "pop", "popitem", "popleft", "remove", "discard", "clear",
+    "update", "setdefault", "sort", "reverse",
+}
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+THREAD_CTORS = {"threading.Thread", "Thread"}
+
+
+@dataclasses.dataclass
+class _Access:
+    method: str
+    line: int
+    locked: bool
+    write: bool
+
+
+class LockDisciplinePass(LintPass):
+    name = "locks"
+    rules = (RULE,)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(node, ctx))
+        return findings
+
+    # ------------------------------------------------------------------ #
+
+    def _check_class(self, cls: ast.ClassDef, ctx: FileContext) -> list[Finding]:
+        methods = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        lock_attrs = self._lock_attrs(methods)
+        if not lock_attrs:
+            return []
+        thread_entries = self._thread_entries(methods)
+
+        accesses: dict[str, list[_Access]] = {}
+        for m in methods:
+            locked_whole = m.name.endswith("_locked")
+            for stmt in m.body:
+                self._visit(
+                    stmt, locked_whole, lock_attrs, accesses, m.name
+                )
+
+        findings: list[Finding] = []
+        for attr, acc in sorted(accesses.items()):
+            if attr in lock_attrs:
+                continue
+            guarded = any(a.locked and a.write for a in acc)
+            if not guarded:
+                continue
+            for a in acc:
+                if a.locked or a.method == "__init__":
+                    continue
+                if a.write:
+                    entry = (
+                        " (thread-entry method)"
+                        if a.method in thread_entries
+                        else ""
+                    )
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=ctx.path,
+                            line=a.line,
+                            severity="error",
+                            message=(
+                                f"{cls.name}.{a.method}: self.{attr} is "
+                                f"lock-guarded elsewhere in {cls.name} but "
+                                f"mutated here without the lock{entry}"
+                            ),
+                        )
+                    )
+                elif a.method in thread_entries:
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=ctx.path,
+                            line=a.line,
+                            severity="error",
+                            message=(
+                                f"{cls.name}.{a.method}: thread entry point "
+                                f"reads lock-guarded self.{attr} without "
+                                "the lock"
+                            ),
+                        )
+                    )
+        return findings
+
+    def _lock_attrs(self, methods) -> set[str]:
+        out: set[str] = set()
+        for m in methods:
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                    if call_name(n.value.func) in LOCK_CTORS:
+                        for t in n.targets:
+                            if is_self_attr(t):
+                                out.add(t.attr)
+        return out
+
+    def _thread_entries(self, methods) -> set[str]:
+        out: set[str] = set()
+        for m in methods:
+            for n in ast.walk(m):
+                if (
+                    isinstance(n, ast.Call)
+                    and call_name(n.func) in THREAD_CTORS
+                ):
+                    for kw in n.keywords:
+                        if kw.arg == "target" and is_self_attr(kw.value):
+                            out.add(kw.value.attr)
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    def _visit(
+        self,
+        node: ast.AST,
+        locked: bool,
+        lock_attrs: set[str],
+        accesses: dict[str, list[_Access]],
+        mname: str,
+    ) -> None:
+        """Single-visit walk carrying the ``with self.<lock>`` context."""
+
+        def rec(attr: str, line: int, write: bool) -> None:
+            accesses.setdefault(attr, []).append(
+                _Access(method=mname, line=line, locked=locked, write=write)
+            )
+
+        def record_target(t: ast.AST) -> None:
+            if is_self_attr(t):
+                rec(t.attr, t.lineno, True)
+            elif isinstance(t, ast.Subscript):
+                if is_self_attr(t.value):
+                    rec(t.value.attr, t.lineno, True)
+                self._visit(t.slice, locked, lock_attrs, accesses, mname)
+            elif isinstance(t, (ast.Tuple, ast.List, ast.Starred)):
+                for el in ast.iter_child_nodes(t):
+                    record_target(el)
+
+        if isinstance(node, ast.With):
+            holds = any(
+                is_self_attr(item.context_expr)
+                and item.context_expr.attr in lock_attrs
+                for item in node.items
+            )
+            for item in node.items:
+                self._visit(
+                    item.context_expr, locked, lock_attrs, accesses, mname
+                )
+            for s in node.body:
+                self._visit(s, locked or holds, lock_attrs, accesses, mname)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs run later, possibly on another thread: the
+            # enclosing lock is NOT held when they execute
+            for s in node.body:
+                self._visit(s, False, lock_attrs, accesses, mname)
+            return
+        if isinstance(node, ast.Lambda):
+            self._visit(node.body, False, lock_attrs, accesses, mname)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                record_target(t)
+            self._visit(node.value, locked, lock_attrs, accesses, mname)
+            return
+        if isinstance(node, ast.AugAssign):
+            record_target(node.target)
+            self._visit(node.value, locked, lock_attrs, accesses, mname)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                record_target(node.target)
+                self._visit(node.value, locked, lock_attrs, accesses, mname)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                record_target(t)
+            return
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and is_self_attr(node.func.value)
+            and node.func.attr in MUTATORS
+        ):
+            rec(node.func.value.attr, node.lineno, True)
+            for arg in node.args:
+                self._visit(arg, locked, lock_attrs, accesses, mname)
+            for kw in node.keywords:
+                self._visit(kw.value, locked, lock_attrs, accesses, mname)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and is_self_attr(node)
+            and isinstance(node.ctx, ast.Load)
+        ):
+            rec(node.attr, node.lineno, False)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, locked, lock_attrs, accesses, mname)

@@ -1,0 +1,316 @@
+"""``metric-registry`` — one definition site for every metric name.
+
+The platform's observability contract is its ``kft_*`` /
+``kubeflow_tpu_*`` exposition names: dashboards, the chaos harness, and
+smoke assertions all key off them, so a typo'd or drifting name is a
+silent outage of the signal. This pass enforces:
+
+1. **single definition site** — every metric-name string literal lives in
+   ``kubeflow_tpu/obs/names.py``; anywhere else a bare literal (including
+   an f-string prefix like ``f"kubeflow_tpu_engine_{key}"``) is flagged;
+2. **known names only** — a literal whose value matches no ``names.py``
+   constant is recorded-but-never-registered (usually a typo);
+3. **kind coherence** — the same name registered as counter at one site
+   and gauge/histogram at another is flagged at the later site;
+4. **label coherence** — the same name registered with different label
+   sets drifts the exposition schema and is flagged;
+5. **dead names** — a ``names.py`` constant nothing references is a
+   warning (the registration it documented is gone).
+
+Registration sites are recognized as ``<...>REGISTRY.counter|gauge|
+histogram(name, ...)`` calls (the ``obs.prom`` first-party registry).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from kubeflow_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    LintPass,
+    is_docstring,
+)
+
+RULE = "metric-registry"
+
+NAMES_PATH = "kubeflow_tpu/obs/names.py"
+METRIC_RE = re.compile(r"^(?:kft|kubeflow_tpu)_[a-z0-9_]+$")
+#: metric name at the START of an f-string literal chunk (exposition lines
+#: and dynamic-name construction both begin with the name/prefix)
+FSTRING_RE = re.compile(r"^(?:kft|kubeflow_tpu)_[a-z0-9_]+")
+REG_METHODS = ("counter", "gauge", "histogram")
+
+
+@dataclasses.dataclass
+class _Registration:
+    path: str
+    line: int
+    kind: str
+    #: ("lit", value) | ("ref", identifier) | ("dyn", None)
+    name: tuple[str, str | None]
+    labels: tuple[str, ...] | None  # None = not statically known
+
+
+class MetricRegistryPass(LintPass):
+    name = "metricnames"
+    rules = (RULE,)
+
+    def begin(self, config) -> None:
+        self._constants: dict[str, str] = {}  # identifier → value
+        self._used_idents: set[str] = set()
+        self._registrations: list[_Registration] = []
+        self._literal_findings: list[tuple[str, int, str]] = []
+        self._literal_seen: set[tuple[str, int, str]] = set()
+        #: dead-name warnings need the usage scan to have covered the
+        #: whole package; a narrowed `kft lint some/path` run hasn't
+        self._names_scanned = False
+        # the constants themselves must resolve even when discovery is
+        # narrowed to a path subset that excludes names.py
+        path = os.path.join(config.root, NAMES_PATH)
+        try:
+            tree = ast.parse(open(path, encoding="utf-8").read())
+        except (OSError, SyntaxError):
+            return
+        self._collect_constants(tree)
+
+    def _collect_constants(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Constant
+            ) and isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._constants[t.id] = node.value.value
+
+    # ------------------------------------------------------------------ #
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        is_names = ctx.path.endswith(NAMES_PATH) or ctx.path == NAMES_PATH
+        if is_names:
+            self._names_scanned = True
+            self._collect_constants(ctx.tree)
+            return []
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                self._maybe_registration(node, ctx)
+            if isinstance(node, ast.Name):
+                self._used_idents.add(node.id)
+            if isinstance(node, ast.Attribute):
+                self._used_idents.add(node.attr)
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and METRIC_RE.match(node.value)
+                and not is_docstring(ctx.tree, node)
+            ):
+                self._add_literal(ctx.path, node.lineno, node.value)
+            if isinstance(node, ast.JoinedStr):
+                for part in node.values:
+                    if isinstance(part, ast.Constant) and isinstance(
+                        part.value, str
+                    ):
+                        m = FSTRING_RE.match(part.value)
+                        if m:
+                            self._add_literal(
+                                ctx.path, node.lineno, m.group(0)
+                            )
+        return []
+
+    def _add_literal(self, path: str, line: int, value: str) -> None:
+        # dedupe: an f-string's literal chunk is also walked as a Constant
+        key = (path, line, value)
+        if key not in self._literal_seen:
+            self._literal_seen.add(key)
+            self._literal_findings.append(key)
+
+    def _maybe_registration(self, call: ast.Call, ctx: FileContext) -> None:
+        func = call.func
+        if not (
+            isinstance(func, ast.Attribute) and func.attr in REG_METHODS
+        ):
+            return
+        recv = func.value
+        recv_name = (
+            recv.id
+            if isinstance(recv, ast.Name)
+            else recv.attr
+            if isinstance(recv, ast.Attribute)
+            else None
+        )
+        if recv_name not in ("REGISTRY", "registry"):
+            return
+        if not call.args:
+            return
+        name_arg = call.args[0]
+        if isinstance(name_arg, ast.Constant) and isinstance(
+            name_arg.value, str
+        ):
+            name = ("lit", name_arg.value)
+        elif isinstance(name_arg, ast.Attribute):
+            name = ("ref", name_arg.attr)
+        elif isinstance(name_arg, ast.Name):
+            name = ("ref", name_arg.id)
+        else:
+            name = ("dyn", None)
+        labels = self._labels_of(call)
+        self._registrations.append(
+            _Registration(
+                path=ctx.path,
+                line=call.lineno,
+                kind=func.attr,
+                name=name,
+                labels=labels,
+            )
+        )
+
+    def _labels_of(self, call: ast.Call) -> tuple[str, ...] | None:
+        node = None
+        if len(call.args) >= 3:
+            node = call.args[2]
+        for kw in call.keywords:
+            if kw.arg in ("labels", "label_names"):
+                node = kw.value
+        if node is None:
+            return ()
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.append(el.value)
+                else:
+                    return None
+            return tuple(out)
+        return None
+
+    # ------------------------------------------------------------------ #
+
+    def finish(self) -> list[Finding]:
+        findings: list[Finding] = []
+        known_values = set(self._constants.values())
+
+        # (1)+(2): bare literals outside names.py
+        for path, line, value in self._literal_findings:
+            extra = ""
+            if value not in known_values:
+                extra = (
+                    " — and it matches no obs/names.py constant "
+                    "(recorded but never registered? typo?)"
+                )
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=path,
+                    line=line,
+                    severity="error",
+                    message=(
+                        f'bare metric-name literal "{value}"; use the '
+                        f"constant from kubeflow_tpu/obs/names.py{extra}"
+                    ),
+                )
+            )
+
+        # resolve registrations to concrete values
+        by_value: dict[str, list[tuple[_Registration, str]]] = {}
+        for reg in self._registrations:
+            mode, ident = reg.name
+            if mode == "dyn":
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=reg.path,
+                        line=reg.line,
+                        severity="error",
+                        message=(
+                            f"dynamic metric name at {reg.kind}() "
+                            "registration; register each name via an "
+                            "obs/names.py constant"
+                        ),
+                    )
+                )
+                continue
+            if mode == "lit":
+                value = ident
+            else:
+                value = self._constants.get(ident or "")
+                if value is None:
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=reg.path,
+                            line=reg.line,
+                            severity="error",
+                            message=(
+                                f"metric registered via {ident!r}, which is "
+                                "not a kubeflow_tpu/obs/names.py constant"
+                            ),
+                        )
+                    )
+                    continue
+            by_value.setdefault(value, []).append((reg, reg.kind))
+
+        # (3) kind coherence + (4) label coherence
+        for value, regs in sorted(by_value.items()):
+            kinds = {k for _, k in regs}
+            if len(kinds) > 1:
+                first = regs[0][0]
+                for reg, kind in regs[1:]:
+                    if kind != regs[0][1]:
+                        findings.append(
+                            Finding(
+                                rule=RULE,
+                                path=reg.path,
+                                line=reg.line,
+                                severity="error",
+                                message=(
+                                    f'metric "{value}" registered as '
+                                    f"{kind} here but as {regs[0][1]} at "
+                                    f"{first.path}:{first.line}"
+                                ),
+                            )
+                        )
+            labelsets = {
+                reg.labels for reg, _ in regs if reg.labels is not None
+            }
+            if len(labelsets) > 1:
+                first = regs[0][0]
+                for reg, _ in regs[1:]:
+                    if reg.labels is not None and reg.labels != first.labels:
+                        findings.append(
+                            Finding(
+                                rule=RULE,
+                                path=reg.path,
+                                line=reg.line,
+                                severity="error",
+                                message=(
+                                    f'metric "{value}" label set '
+                                    f"{list(reg.labels)} drifts from "
+                                    f"{list(first.labels or ())} at "
+                                    f"{first.path}:{first.line}"
+                                ),
+                            )
+                        )
+
+        # (5) dead names — only meaningful when the usage scan covered the
+        # package (names.py itself was among the scanned files)
+        if not self._names_scanned:
+            return findings
+        for ident in sorted(self._constants):
+            if ident not in self._used_idents:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=NAMES_PATH,
+                        line=1,
+                        severity="warning",
+                        message=(
+                            f"names.{ident} is defined but never referenced "
+                            "by any recorder/registrar"
+                        ),
+                    )
+                )
+        return findings

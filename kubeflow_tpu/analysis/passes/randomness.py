@@ -1,0 +1,96 @@
+"""``unseeded-random`` — the chaos and sched planes must stay seedable.
+
+Chaos runs are replayable by contract (``FaultPlan.seed`` drives every
+victim/byte choice) and the quota scheduler's jittered cooldowns take a
+``jitter_seed``; a single call into the process-global ``random`` module
+(or ``np.random``) silently breaks that determinism. This pass flags:
+
+- ``random.<fn>(...)`` module-level draws (``random.random``,
+  ``random.choice``, ...) — everything except constructing a seeded
+  ``random.Random(seed)``;
+- ``random.Random()`` constructed with *no* seed;
+- ``np.random.<fn>(...)`` global-state draws — ``default_rng(seed)``
+  with an explicit seed is the allowed spelling;
+- ``from random import choice``-style imports that smuggle the global
+  API in under a bare name.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.engine import FileContext, Finding, LintPass
+
+RULE = "unseeded-random"
+
+
+class RandomnessPass(LintPass):
+    name = "randomness"
+    rules = (RULE,)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+
+        def flag(node: ast.AST, message: str) -> None:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=ctx.path,
+                    line=node.lineno,
+                    severity="error",
+                    message=message,
+                )
+            )
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "random":
+                bad = [
+                    a.name
+                    for a in node.names
+                    if a.name not in ("Random", "SystemRandom")
+                ]
+                if bad:
+                    flag(
+                        node,
+                        f"from random import {', '.join(bad)} pulls the "
+                        "process-global RNG into a seedable plane; thread "
+                        "an explicit random.Random(seed) instead",
+                    )
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            # random.<fn>(...) and random.Random()
+            if isinstance(func.value, ast.Name) and func.value.id == "random":
+                if func.attr == "Random":
+                    if not node.args and not node.keywords:
+                        flag(
+                            node,
+                            "random.Random() without a seed breaks chaos/"
+                            "sched replayability; pass the plan's seed",
+                        )
+                elif func.attr != "SystemRandom":
+                    flag(
+                        node,
+                        f"random.{func.attr}() draws from the process-"
+                        "global RNG; chaos/sched are contractually "
+                        "seedable — use an injected random.Random(seed)",
+                    )
+            # np.random.<fn>(...)
+            if (
+                isinstance(func.value, ast.Attribute)
+                and func.value.attr == "random"
+                and isinstance(func.value.value, ast.Name)
+                and func.value.value.id in ("np", "numpy")
+            ):
+                if func.attr == "default_rng" and (
+                    node.args or node.keywords
+                ):
+                    continue  # seeded generator: the allowed spelling
+                flag(
+                    node,
+                    f"np.random.{func.attr} uses numpy's global RNG; use "
+                    "np.random.default_rng(seed) threaded from the plan",
+                )
+        return findings

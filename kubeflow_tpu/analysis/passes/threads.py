@@ -1,0 +1,131 @@
+"""Thread-hygiene lints: ``thread-join`` and ``monotonic-clock``.
+
+``thread-join`` — a non-daemon ``threading.Thread`` that no
+``stop()``/``close()``/``shutdown()``/``__exit__`` joins will wedge
+interpreter exit (the exact leak smoke.sh's post-fit thread check hunts).
+Spawns must either pass ``daemon=True`` explicitly or live in a class
+whose teardown method joins.
+
+``monotonic-clock`` — supervision clocks (heartbeat staleness, startup
+grace, progress timeouts, notebook idle culling) measure *durations*; on
+``time.time()`` they silently mis-fire across NTP steps and wall-clock
+jumps. Within the scoped files every ``time.time`` reference is flagged —
+stamp and compare with ``time.monotonic()`` (shared across processes on
+the same host: CLOCK_MONOTONIC is boot-relative system-wide on Linux).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    LintPass,
+    call_name,
+)
+
+JOIN_RULE = "thread-join"
+CLOCK_RULE = "monotonic-clock"
+
+TEARDOWN_METHODS = {"stop", "close", "shutdown", "__exit__", "join"}
+
+
+class ThreadHygienePass(LintPass):
+    name = "threads"
+    rules = (JOIN_RULE, CLOCK_RULE)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        findings.extend(self._check_spawns(ctx))
+        findings.extend(self._check_clocks(ctx))
+        return findings
+
+    # -- thread-join ---------------------------------------------------- #
+
+    def _check_spawns(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for cls, call in self._thread_ctors(ctx.tree):
+            daemon = None
+            for kw in call.keywords:
+                if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                    daemon = bool(kw.value.value)
+            if daemon:
+                continue
+            if cls is not None and self._class_joins(cls):
+                continue
+            where = f" in class {cls.name}" if cls is not None else ""
+            findings.append(
+                Finding(
+                    rule=JOIN_RULE,
+                    path=ctx.path,
+                    line=call.lineno,
+                    severity="error",
+                    message=(
+                        "non-daemon Thread spawned"
+                        + where
+                        + " with no join in any stop()/close()/shutdown()/"
+                        "__exit__ — it will outlive its owner and wedge "
+                        "interpreter exit; pass daemon=True or join it in "
+                        "teardown"
+                    ),
+                )
+            )
+        return findings
+
+    def _thread_ctors(self, tree: ast.Module):
+        """Yield ``(enclosing_class_or_None, Thread(...) call)`` pairs."""
+        def walk(node, cls):
+            for child in ast.iter_child_nodes(node):
+                child_cls = child if isinstance(child, ast.ClassDef) else cls
+                if (
+                    isinstance(child, ast.Call)
+                    and call_name(child.func)
+                    in ("threading.Thread", "Thread")
+                ):
+                    yield (cls, child)
+                yield from walk(child, child_cls)
+
+        yield from walk(tree, None)
+
+    def _class_joins(self, cls: ast.ClassDef) -> bool:
+        for m in cls.body:
+            if (
+                isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and m.name in TEARDOWN_METHODS
+            ):
+                for n in ast.walk(m):
+                    if (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "join"
+                    ):
+                        return True
+        return False
+
+    # -- monotonic-clock ------------------------------------------------ #
+
+    def _check_clocks(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "time"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "time"
+            ):
+                findings.append(
+                    Finding(
+                        rule=CLOCK_RULE,
+                        path=ctx.path,
+                        line=node.lineno,
+                        severity="error",
+                        message=(
+                            "time.time() in a supervision/duration "
+                            "context — wall-clock jumps (NTP step, VM "
+                            "migrate) break grace and progress clocks; "
+                            "use time.monotonic()"
+                        ),
+                    )
+                )
+        return findings

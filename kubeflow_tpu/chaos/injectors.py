@@ -20,17 +20,17 @@ import random
 import time
 from pathlib import Path
 
-from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs import names, prom
 
 logger = logging.getLogger(__name__)
 
 CHAOS_INJECTED = prom.REGISTRY.counter(
-    "kft_chaos_injected_total",
+    names.CHAOS_INJECTED_TOTAL,
     "faults injected by the chaos harness",
     labels=("kind",),
 )
 RECOVERY_SECONDS = prom.REGISTRY.histogram(
-    "kft_recovery_seconds",
+    names.RECOVERY_SECONDS,
     "wall time from a disruptive fault to demonstrated recovery "
     "(progress past the pre-fault step, or a terminal Succeeded)",
 )

@@ -28,6 +28,10 @@ Subcommands:
 - ``kft chaos run``    — run Job manifests under a declarative FaultPlan
   (``--plan plan.yaml``): inject every named failure at its trigger step,
   report what fired and whether the job recovered.
+- ``kft lint``         — repo-native AST static analysis (``analysis/``):
+  lock-discipline races, metric-name registry drift, JAX hot-loop sync
+  violations, thread/clock hygiene, unseeded randomness; ``--strict`` is
+  the CI gate (exit 0 clean / 1 findings / 2 usage error).
 - ``kft doctor``       — accelerator liveness via the subprocess probe
   (never hangs on a wedged tunnel) + device inventory.
 - ``kft version``.
@@ -687,6 +691,73 @@ def _cmd_chaos(args) -> int:
     return 1 if failed else 0
 
 
+def _cmd_lint(args) -> int:
+    """Run the repo-native static-analysis passes (``analysis/``): exit 0
+    clean, 1 on findings, 2 on usage errors. ``--strict`` also fails on
+    warnings and stale baseline entries — the CI spelling."""
+    from kubeflow_tpu.analysis import engine as lint_engine
+
+    root = args.root or os.getcwd()
+    config = lint_engine.load_config(root)
+    if args.baseline is not None:
+        config.baseline = args.baseline
+    try:
+        result = lint_engine.run_lint(
+            config,
+            rules=args.rule or None,
+            paths=args.paths or None,
+            baseline=not (args.no_baseline or args.update_baseline),
+        )
+    except ValueError as e:  # unknown rule
+        print(f"kft lint: {e}", file=sys.stderr)
+        return 2
+    if result.parse_errors:
+        for err in result.parse_errors:
+            print(f"kft lint: cannot parse {err}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        if not config.baseline:
+            print("kft lint: no baseline path configured", file=sys.stderr)
+            return 2
+        path = os.path.join(root, config.baseline)
+        lint_engine.write_baseline(result.findings, path)
+        print(
+            f"kft lint: pinned {len(result.findings)} finding(s) to "
+            f"{config.baseline}"
+        )
+        return 0
+
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=1, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        tail = (
+            f"kft lint: {len(result.findings)} finding(s) in "
+            f"{result.files} files"
+        )
+        if result.baseline_matched:
+            tail += f" ({result.baseline_matched} pinned by baseline)"
+        if result.noqa_suppressed:
+            tail += f" ({result.noqa_suppressed} noqa-suppressed)"
+        print(tail)
+        for fp in result.stale_baseline:
+            print(
+                f"kft lint: stale baseline entry {list(fp)} — prune it",
+                file=sys.stderr,
+            )
+
+    failing = [
+        f
+        for f in result.findings
+        if args.strict or f.severity == "error"
+    ]
+    if args.strict and result.stale_baseline:
+        return 1
+    return 1 if failing else 0
+
+
 def _cmd_doctor(args) -> int:
     from kubeflow_tpu.core.deviceprobe import UNREACHABLE, probe_backend
 
@@ -833,6 +904,28 @@ def main(argv: list[str] | None = None) -> int:
     ch.add_argument("--json", action="store_true",
                     help="also print the machine-readable chaos report")
     ch.set_defaults(fn=_cmd_chaos)
+
+    li = sub.add_parser(
+        "lint", help="repo-native AST invariant checks (analysis/ passes)"
+    )
+    li.add_argument("paths", nargs="*", default=[],
+                    help="files/dirs to lint (default: [tool.kft-lint] "
+                         "include globs)")
+    li.add_argument("--strict", action="store_true",
+                    help="fail on warnings and stale baseline entries too")
+    li.add_argument("--rule", action="append", default=[],
+                    help="run only this rule (repeatable)")
+    li.add_argument("--json", action="store_true",
+                    help="machine-readable findings document")
+    li.add_argument("--root", default=None,
+                    help="repo root holding pyproject.toml (default: cwd)")
+    li.add_argument("--baseline", default=None,
+                    help="override the baseline file path")
+    li.add_argument("--no-baseline", action="store_true",
+                    help="report pinned legacy findings too")
+    li.add_argument("--update-baseline", action="store_true",
+                    help="pin the current findings as the new baseline")
+    li.set_defaults(fn=_cmd_lint)
 
     d = sub.add_parser("doctor", help="accelerator liveness + inventory")
     d.add_argument("--timeout", type=float, default=120.0)

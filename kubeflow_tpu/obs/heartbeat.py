@@ -49,13 +49,18 @@ def heartbeat_path_from_env(env: dict[str, str] | None = None) -> Path | None:
 
 @dataclasses.dataclass
 class Heartbeat:
+    #: ``time.monotonic()`` stamp, NOT wall clock: staleness is duration
+    #: math, and a wall-clock jump (NTP step) must never read as a hung or
+    #: miraculously-fresh worker. CLOCK_MONOTONIC is boot-relative
+    #: system-wide on Linux, so stamps compare correctly across the
+    #: worker/supervisor process boundary on the same host.
     time: float
     pid: int
     step: int = -1
     attempt: int = 0
 
     def age(self, now: float | None = None) -> float:
-        return (time.time() if now is None else now) - self.time
+        return (time.monotonic() if now is None else now) - self.time
 
 
 class HeartbeatWriter:
@@ -100,23 +105,26 @@ class HeartbeatWriter:
             self.beat()
 
     def beat(self, step: int | None = None) -> None:
-        if step is not None:
-            self._step = step
-        payload = json.dumps(
-            dataclasses.asdict(
-                Heartbeat(
-                    time=time.time(),
-                    pid=os.getpid(),
-                    step=self._step,
-                    attempt=self.attempt,
+        tmp = self.path.with_suffix(".tmp")
+        # Lock held from step update through publish: the background thread
+        # and explicit beat(step) callers share one tmp file — unserialised,
+        # a replace could publish a truncated write, and a payload built
+        # outside the lock could publish an OLDER step after a newer one
+        # (the drain stamps step N, the background beat overwrites with
+        # N-1), making observed progress regress.
+        with self._write_lock:
+            if step is not None:
+                self._step = step
+            payload = json.dumps(
+                dataclasses.asdict(
+                    Heartbeat(
+                        time=time.monotonic(),
+                        pid=os.getpid(),
+                        step=self._step,
+                        attempt=self.attempt,
+                    )
                 )
             )
-        )
-        tmp = self.path.with_suffix(".tmp")
-        # Lock: the background thread and explicit beat(step) callers share
-        # one tmp file; unserialised, a replace could publish a truncated
-        # write and a torn read would look like a missing beat.
-        with self._write_lock:
             tmp.write_text(payload)
             os.replace(tmp, self.path)  # atomic: readers never see torn data
 
@@ -152,6 +160,7 @@ def is_stale(
     """True when the latest beat (of at least ``min_attempt``) is older than
     ``timeout``. A missing file is NOT stale — the worker may not have
     reached its first beat; the supervisor separately grace-periods startup.
+    ``now`` must come from ``time.monotonic()`` (beats are stamped with it).
     """
     hb = read_heartbeat(path)
     if hb is None or hb.attempt < min_attempt:

@@ -1,0 +1,79 @@
+"""The single definition site for every exposition name the platform emits.
+
+Dashboards, smoke assertions, the chaos harness, and external Prometheus
+scrape configs all key off these strings — a typo'd or drifting name is a
+silent outage of the signal it carried. ``kft lint``'s ``metric-registry``
+pass enforces that no ``kft_*`` / ``kubeflow_tpu_*`` literal appears
+anywhere else in the package: recorders and registrars must reference
+these constants, so renames are single-line diffs and every name in the
+exposition provably has exactly one owner.
+
+Grouped by plane. ``*_PREFIX`` constants are the sanctioned dynamic-name
+roots (engine scheduler/pager stats fan out per-key under them).
+"""
+
+from __future__ import annotations
+
+# -- orchestrator (control plane) ------------------------------------- #
+
+#: histogram — controller sync_all wall time
+RECONCILE_SECONDS = "kft_reconcile_seconds"
+#: gauge{phase} — jobs currently in the store by phase
+JOBS_BY_PHASE = "kft_jobs"
+#: counter{reason} — workers killed by the heartbeat supervisor
+SUPERVISOR_KILLS_TOTAL = "kft_supervisor_kills_total"
+#: counter — gang restarts triggered by worker failures
+GANG_RESTARTS_TOTAL = "kft_gang_restarts_total"
+#: counter{reason} — gangs requeued after losing placement
+GANG_REQUEUES_TOTAL = "kft_gang_requeues_total"
+#: counter{condition,reason} — jobs reaching a terminal condition
+JOBS_FINISHED_TOTAL = "kft_jobs_finished_total"
+
+# -- quota scheduler (sched/) ------------------------------------------ #
+
+#: gauge{queue,generation} — nominal chip quota per ClusterQueue
+QUEUE_NOMINAL_CHIPS = "kft_queue_nominal_chips"
+#: gauge{queue,generation} — chips held beyond nominal (cohort-borrowed)
+QUEUE_BORROWED_CHIPS = "kft_queue_borrowed_chips"
+#: gauge{queue} — workloads waiting for quota admission
+QUEUE_PENDING_WORKLOADS = "kft_queue_pending_workloads"
+#: counter{reason} — workloads preempted by the quota scheduler
+PREEMPTIONS_TOTAL = "kft_preemptions_total"
+#: histogram{queue} — enqueue-to-admission wait
+QUEUE_WAIT_SECONDS = "kft_queue_wait_seconds"
+
+# -- chaos harness ------------------------------------------------------ #
+
+#: counter{kind} — faults the chaos runner actually injected
+CHAOS_INJECTED_TOTAL = "kft_chaos_injected_total"
+#: histogram — fault-to-recovered wall time
+RECOVERY_SECONDS = "kft_recovery_seconds"
+
+# -- training ----------------------------------------------------------- #
+
+#: counter — restores that walked past a corrupt/unreadable step
+CHECKPOINT_FALLBACKS_TOTAL = "kft_checkpoint_fallbacks_total"
+#: gauges — the hot-loop overlap split (train/prefetch.py, train/metrics.py)
+TRAIN_DATA_STALL_MS = "kubeflow_tpu_train_data_stall_ms"
+TRAIN_H2D_MS = "kubeflow_tpu_train_h2d_ms"
+TRAIN_DEVICE_STEP_MS = "kubeflow_tpu_train_device_step_ms"
+TRAIN_COMPILE_MS = "kubeflow_tpu_train_compile_ms"
+TRAIN_STEPS_PER_SEC = "kubeflow_tpu_train_steps_per_sec"
+
+# -- serving ------------------------------------------------------------ #
+
+#: counter{model} — model loads that raised (ModelMesh)
+MODELMESH_LOAD_FAILURES_TOTAL = "kft_modelmesh_load_failures_total"
+#: gauges{model} — batcher occupancy (shared registry + /metrics)
+BATCHER_BATCHES = "kubeflow_tpu_batcher_batches"
+BATCHER_INSTANCES = "kubeflow_tpu_batcher_instances"
+BATCHER_MEAN_OCCUPANCY = "kubeflow_tpu_batcher_mean_occupancy"
+#: dataplane request metrics (ModelServer /metrics exposition)
+REQUESTS_TOTAL = "kubeflow_tpu_requests_total"
+LATENCY_P50_MS = "kubeflow_tpu_latency_p50_ms"
+LATENCY_P99_MS = "kubeflow_tpu_latency_p99_ms"
+#: continuous-batching engine gauges; per-key stats fan out under the
+#: prefixes (scheduler stats, paged-KV pool pressure)
+ENGINE_ACTIVE_ROWS = "kubeflow_tpu_engine_active_rows"
+ENGINE_PREFIX = "kubeflow_tpu_engine_"
+ENGINE_KV_PREFIX = "kubeflow_tpu_engine_kv_"

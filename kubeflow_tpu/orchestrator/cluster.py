@@ -15,7 +15,7 @@ import tempfile
 import threading
 import time
 
-from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs import names, prom
 from kubeflow_tpu.orchestrator.envwire import WiringConfig
 from kubeflow_tpu.orchestrator.gang import GangScheduler
 from kubeflow_tpu.orchestrator.launcher import ProcessLauncher
@@ -29,10 +29,11 @@ from kubeflow_tpu.orchestrator.webhooks import AdmissionChain
 logger = logging.getLogger(__name__)
 
 SYNC_SECONDS = prom.REGISTRY.histogram(
-    "kft_reconcile_seconds", "controller sync_all wall time"
+    names.RECONCILE_SECONDS, "controller sync_all wall time"
 )
 JOBS_BY_PHASE = prom.REGISTRY.gauge(
-    "kft_jobs", "jobs currently in the store by phase", labels=("phase",)
+    names.JOBS_BY_PHASE, "jobs currently in the store by phase",
+    labels=("phase",),
 )
 
 

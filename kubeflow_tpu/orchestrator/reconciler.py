@@ -24,7 +24,7 @@ import logging
 import signal
 import time
 
-from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs import names, prom
 from kubeflow_tpu.orchestrator import envwire
 from kubeflow_tpu.orchestrator.gang import GangScheduler, PodGroup
 from kubeflow_tpu.orchestrator.launcher import ProcessLauncher
@@ -43,15 +43,16 @@ from kubeflow_tpu.orchestrator.store import ObjectStore
 logger = logging.getLogger(__name__)
 
 GANG_RESTARTS = prom.REGISTRY.counter(
-    "kft_gang_restarts_total", "gang restarts triggered by worker failures"
+    names.GANG_RESTARTS_TOTAL,
+    "gang restarts triggered by worker failures",
 )
 GANG_REQUEUES = prom.REGISTRY.counter(
-    "kft_gang_requeues_total",
+    names.GANG_REQUEUES_TOTAL,
     "gangs sent back to the scheduler queue after losing placement",
     labels=("reason",),
 )
 JOBS_FINISHED = prom.REGISTRY.counter(
-    "kft_jobs_finished_total", "jobs reaching a terminal condition",
+    names.JOBS_FINISHED_TOTAL, "jobs reaching a terminal condition",
     labels=("condition", "reason"),
 )
 

@@ -34,7 +34,7 @@ import logging
 import time
 
 from kubeflow_tpu.obs import heartbeat as hb
-from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs import names, prom
 from kubeflow_tpu.orchestrator.launcher import ProcessLauncher
 from kubeflow_tpu.orchestrator.spec import WorkerPhase, WorkerStatus
 from kubeflow_tpu.orchestrator.store import ObjectStore
@@ -42,7 +42,7 @@ from kubeflow_tpu.orchestrator.store import ObjectStore
 logger = logging.getLogger(__name__)
 
 KILLS = prom.REGISTRY.counter(
-    "kft_supervisor_kills_total",
+    names.SUPERVISOR_KILLS_TOTAL,
     "workers killed by the heartbeat supervisor",
     labels=("reason",),
 )
@@ -79,8 +79,11 @@ class HeartbeatSupervisor:
                 del tags[tag]
 
     def check(self, now: float | None = None) -> list[str]:
-        """One supervision pass; returns the keys it killed."""
-        now = time.time() if now is None else now
+        """One supervision pass; returns the keys it killed. ``now`` is a
+        ``time.monotonic()`` reading: every clock here (startup grace,
+        beat staleness, progress stall) measures a duration, and a
+        wall-clock step must never execute a healthy worker."""
+        now = time.monotonic() if now is None else now
         killed: list[str] = []
         live: set[tuple[str, int, int | None]] = set()
         for uid, job in self.jobs.list():

@@ -45,7 +45,9 @@ class NotebookSpec:
 class NotebookStatus:
     phase: str = "Pending"  # Pending | Running | Culled | Failed
     job_uid: str | None = None
-    last_activity: float = dataclasses.field(default_factory=time.time)
+    #: time.monotonic() stamp — idle culling is duration math and must
+    #: survive wall-clock jumps (same contract as obs.heartbeat)
+    last_activity: float = dataclasses.field(default_factory=time.monotonic)
     culled_at: float | None = None
 
 
@@ -97,7 +99,8 @@ class NotebookController:
     def touch(self, name: str, namespace: str = "default") -> None:
         """Record user activity (the web app's probe analog)."""
         with self._lock:
-            self._notebooks[(namespace, name)][1].last_activity = time.time()
+            status = self._notebooks[(namespace, name)][1]
+            status.last_activity = time.monotonic()
 
     def wake(self, name: str, namespace: str = "default") -> NotebookStatus:
         """Re-start a culled notebook."""
@@ -105,14 +108,15 @@ class NotebookController:
             spec, status = self._notebooks[(namespace, name)]
             if status.phase != "Culled":
                 return status
-            status.last_activity = time.time()
+            status.last_activity = time.monotonic()
             status.culled_at = None
             self._start(spec, status)
             return status
 
     def reconcile(self, now: float | None = None) -> None:
-        """Refresh phases; cull notebooks idle past their deadline."""
-        now = time.time() if now is None else now
+        """Refresh phases; cull notebooks idle past their deadline.
+        ``now`` is a ``time.monotonic()`` reading (beat stamps share it)."""
+        now = time.monotonic() if now is None else now
         with self._lock:
             self._reconcile_locked(now)
 

@@ -94,7 +94,14 @@ class VolumeController:
 
     def _recover(self) -> None:
         """Volumes are DURABLE directories; re-register what survives a
-        process restart (each carries its spec in a meta file)."""
+        process restart (each carries its spec in a meta file). Runs under
+        the lock: __init__ is the only caller today, but ``_volumes`` is
+        lock-guarded state and recovery must stay safe if it ever runs
+        against a live controller (e.g. a future re-scan verb)."""
+        with self._lock:
+            self._recover_locked()
+
+    def _recover_locked(self) -> None:
         import json
 
         for ns in sorted(os.listdir(self.root)):

@@ -29,7 +29,7 @@ from __future__ import annotations
 import logging
 import time
 
-from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs import names, prom
 from kubeflow_tpu.orchestrator.gang import GangScheduler, PodGroup
 from kubeflow_tpu.orchestrator.resources import Fleet
 from kubeflow_tpu.sched.preemption import plan_preemption
@@ -39,27 +39,27 @@ from kubeflow_tpu.sched.workload import Workload, group_chips_by_generation
 logger = logging.getLogger(__name__)
 
 QUEUE_NOMINAL = prom.REGISTRY.gauge(
-    "kft_queue_nominal_chips",
+    names.QUEUE_NOMINAL_CHIPS,
     "nominal chip quota per ClusterQueue and accelerator generation",
     labels=("queue", "generation"),
 )
 QUEUE_BORROWED = prom.REGISTRY.gauge(
-    "kft_queue_borrowed_chips",
+    names.QUEUE_BORROWED_CHIPS,
     "chips each ClusterQueue currently holds beyond nominal (cohort-borrowed)",
     labels=("queue", "generation"),
 )
 QUEUE_PENDING = prom.REGISTRY.gauge(
-    "kft_queue_pending_workloads",
+    names.QUEUE_PENDING_WORKLOADS,
     "workloads waiting for quota admission per ClusterQueue",
     labels=("queue",),
 )
 PREEMPTIONS = prom.REGISTRY.counter(
-    "kft_preemptions_total",
+    names.PREEMPTIONS_TOTAL,
     "workloads preempted by the quota scheduler",
     labels=("reason",),
 )
 QUEUE_WAIT = prom.REGISTRY.histogram(
-    "kft_queue_wait_seconds",
+    names.QUEUE_WAIT_SECONDS,
     "enqueue-to-admission wait per ClusterQueue",
     labels=("queue",),
 )

@@ -998,13 +998,16 @@ class LMEngine:
                     sub,
                 )
             self.stats["chunks"] += 1
-            toks = np.asarray(toks)
-            valid = np.asarray(valid)
+            # decode boundary: generated tokens must reach the host to
+            # stream to clients — this D2H is the product, not a stall, and
+            # it runs on the engine scheduler thread, never a request thread
+            toks = np.asarray(toks)  # kft: noqa[jax-sync] — sanctioned decode-boundary D2H on the scheduler thread
+            valid = np.asarray(valid)  # kft: noqa[jax-sync] — same decode boundary as toks above
             # np.array copies: device-array views are read-only, and _admit
             # writes per-row entries into these
             self.last_tok = np.array(tok)
             self.gen_count = np.array(gen_count)
-            device_active = np.asarray(active)
+            device_active = np.asarray(active)  # kft: noqa[jax-sync] — same decode boundary; row liveness must be host-visible to admit/retire
             for row in range(self.max_batch):
                 req = self._slots[row]
                 if req is None or not self.active[row]:

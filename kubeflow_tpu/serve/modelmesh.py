@@ -27,11 +27,11 @@ from typing import Any, Callable, Mapping
 
 import jax
 
-from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs import names, prom
 from kubeflow_tpu.serve.model import Model
 
 LOAD_FAILURES = prom.REGISTRY.counter(
-    "kft_modelmesh_load_failures_total",
+    names.MODELMESH_LOAD_FAILURES_TOTAL,
     "model loads that raised (per model entry)",
     labels=("model",),
 )

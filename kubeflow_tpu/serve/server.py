@@ -29,7 +29,7 @@ from typing import Any
 
 from aiohttp import web
 
-from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs import names, prom
 from kubeflow_tpu.serve import protocol
 from kubeflow_tpu.serve.batcher import Batcher, BatcherConfig
 from kubeflow_tpu.serve.engine import EngineOverloaded
@@ -40,15 +40,15 @@ from kubeflow_tpu.serve.model import Model
 #: ObsServer's shared /metrics shows them next to the engine pool gauges;
 #: values refresh at scrape time via a Registry collector per batcher.
 BATCHER_BATCHES = prom.REGISTRY.gauge(
-    "kubeflow_tpu_batcher_batches", "handler calls the batcher has made",
+    names.BATCHER_BATCHES, "handler calls the batcher has made",
     ("model",),
 )
 BATCHER_INSTANCES = prom.REGISTRY.gauge(
-    "kubeflow_tpu_batcher_instances", "instances the batcher has coalesced",
+    names.BATCHER_INSTANCES, "instances the batcher has coalesced",
     ("model",),
 )
 BATCHER_MEAN_OCCUPANCY = prom.REGISTRY.gauge(
-    "kubeflow_tpu_batcher_mean_occupancy",
+    names.BATCHER_MEAN_OCCUPANCY,
     "mean instances per handler call (batch fill)", ("model",),
 )
 
@@ -445,27 +445,27 @@ class ModelServer:
         lines = []
         for name, n in self.dataplane.metrics["requests_total"].items():
             lines.append(
-                f'kubeflow_tpu_requests_total{{model="{name}"}} {n}'
+                f'{names.REQUESTS_TOTAL}{{model="{name}"}} {n}'
             )
         for name, lat in self.dataplane.metrics["latency_ms"].items():
             if lat:
                 srt = sorted(lat)
                 p50 = srt[len(srt) // 2]
                 p99 = srt[min(len(srt) - 1, int(len(srt) * 0.99))]
-                lines.append(f'kubeflow_tpu_latency_p50_ms{{model="{name}"}} {p50:.3f}')
-                lines.append(f'kubeflow_tpu_latency_p99_ms{{model="{name}"}} {p99:.3f}')
+                lines.append(f'{names.LATENCY_P50_MS}{{model="{name}"}} {p50:.3f}')
+                lines.append(f'{names.LATENCY_P99_MS}{{model="{name}"}} {p99:.3f}')
         # batcher occupancy gauges, matching the engine's pool gauges
         for name, b in sorted(self.dataplane._batchers.items()):
             lines.append(
-                f'kubeflow_tpu_batcher_batches{{model="{name}"}} '
+                f'{names.BATCHER_BATCHES}{{model="{name}"}} '
                 f'{b.stats["batches"]}'
             )
             lines.append(
-                f'kubeflow_tpu_batcher_instances{{model="{name}"}} '
+                f'{names.BATCHER_INSTANCES}{{model="{name}"}} '
                 f'{b.stats["instances"]}'
             )
             lines.append(
-                f'kubeflow_tpu_batcher_mean_occupancy{{model="{name}"}} '
+                f'{names.BATCHER_MEAN_OCCUPANCY}{{model="{name}"}} '
                 f"{b.mean_occupancy:.3f}"
             )
         # engine-backed models export their scheduler gauges too
@@ -476,17 +476,17 @@ class ModelServer:
                 continue
             for key, val in dict(eng.stats).items():  # snapshot: engine thread writes
                 lines.append(
-                    f'kubeflow_tpu_engine_{key}{{model="{name}"}} {val}'
+                    f'{names.ENGINE_PREFIX}{key}{{model="{name}"}} {val}'
                 )
             lines.append(
-                f'kubeflow_tpu_engine_active_rows{{model="{name}"}} '
+                f'{names.ENGINE_ACTIVE_ROWS}{{model="{name}"}} '
                 f"{int(eng.active.sum())}"
             )
             pager = getattr(eng, "pager", None)
             if pager is not None:  # paged-KV engines: live pool pressure
                 for key, val in pager.stats().items():
                     lines.append(
-                        f'kubeflow_tpu_engine_kv_{key}{{model="{name}"}} '
+                        f'{names.ENGINE_KV_PREFIX}{key}{{model="{name}"}} '
                         f"{val}"
                     )
         return web.Response(text="\n".join(lines) + "\n")

@@ -30,7 +30,7 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
-from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs import names, prom
 
 logger = logging.getLogger(__name__)
 
@@ -39,7 +39,7 @@ logger = logging.getLogger(__name__)
 MANIFEST_NAME = "_KFT_MANIFEST.json"
 
 RESTORE_FALLBACKS = prom.REGISTRY.counter(
-    "kft_checkpoint_fallbacks_total",
+    names.CHECKPOINT_FALLBACKS_TOTAL,
     "restores that walked past a corrupt/unreadable checkpoint step",
 )
 

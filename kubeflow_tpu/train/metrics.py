@@ -27,16 +27,21 @@ from typing import Any, IO, Mapping
 #: - compile_ms:     first-step jit compile, reported once — so
 #:   steps_per_sec never conflates compile with steady state
 def _overlap_gauges():
-    from kubeflow_tpu.obs import prom
+    from kubeflow_tpu.obs import names, prom
 
     return {
-        name: prom.REGISTRY.gauge(f"kubeflow_tpu_train_{name}", help_)
-        for name, help_ in (
-            ("data_stall_ms", "mean ms/batch the loop waited on input data"),
-            ("h2d_ms", "mean ms/batch of host batch assembly + H2D copy"),
-            ("device_step_ms", "mean device step ms (drain ready-to-ready)"),
-            ("compile_ms", "first-step jit compile ms"),
-            ("steps_per_sec", "steady-state training steps per second"),
+        key: prom.REGISTRY.gauge(metric, help_)  # kft: noqa[metric-registry] — `metric` ranges over the names.TRAIN_* constants in the tuple below; no literal can enter
+        for key, metric, help_ in (
+            ("data_stall_ms", names.TRAIN_DATA_STALL_MS,
+             "mean ms/batch the loop waited on input data"),
+            ("h2d_ms", names.TRAIN_H2D_MS,
+             "mean ms/batch of host batch assembly + H2D copy"),
+            ("device_step_ms", names.TRAIN_DEVICE_STEP_MS,
+             "mean device step ms (drain ready-to-ready)"),
+            ("compile_ms", names.TRAIN_COMPILE_MS,
+             "first-step jit compile ms"),
+            ("steps_per_sec", names.TRAIN_STEPS_PER_SEC,
+             "steady-state training steps per second"),
         )
     }
 

@@ -352,7 +352,7 @@ class MetricsDrain:
             # compute producing it (bench.py's honest-timing contract), and
             # block_until_ready here corrupts the heap on this jaxlib when
             # the step's donated state came from an Orbax restore
-            np.asarray(leaves[0])
+            np.asarray(leaves[0])  # kft: noqa[jax-sync] — drain-thread-only single-leaf host transfer; the loop thread never blocks here
         if self._hb is not None:
             # step N's metrics are ready ⇒ step N completed on device:
             # the honest progress stamp for the supervisor's watchdog

@@ -45,6 +45,12 @@ for name, err in failures:
 sys.exit(1 if failures else 0)
 EOF
 
+echo "== kft lint --strict (repo-native invariant checks) =="
+# AST passes over the whole package: lock discipline, metric-name registry,
+# JAX hot-loop sync rules, thread/clock hygiene, seedable randomness.
+# Anything beyond the pinned lint_baseline.json fails the gate.
+python -m kubeflow_tpu lint --strict
+
 echo "== 20-step overlapped Trainer.fit (prefetch on, accum=2) =="
 python - <<'EOF'
 import os, sys, threading

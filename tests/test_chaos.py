@@ -445,7 +445,7 @@ path = os.path.join(workdir, f"heartbeat-{rtype}-{index}.json")
 def beat():
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"time": time.time(), "pid": os.getpid(),
+        json.dump({"time": time.monotonic(), "pid": os.getpid(),
                    "step": 1, "attempt": attempt}, f)
     os.replace(tmp, path)
 beat()

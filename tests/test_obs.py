@@ -134,6 +134,44 @@ def test_heartbeat_from_env(tmp_path, monkeypatch):
     assert beat.attempt == 1
 
 
+def test_heartbeat_published_step_never_regresses(tmp_path):
+    """Race regression (kft lint lock-discipline work): beat(step=N) from
+    the metric drain races the background beat() thread. Before the fix,
+    the payload was built OUTSIDE the write lock, so the background thread
+    could snapshot step N-1, lose the race, and publish it AFTER the drain
+    published N — observed trainer progress (chaos triggers, supervisor
+    progress clocks) would regress. With step update + payload build +
+    publish in one critical section, the file's step is monotonic."""
+    path = heartbeat_path(tmp_path, "worker", 0)
+    # interval=0: the background thread republishes as fast as it can,
+    # maximizing interleavings with the explicit stepped beats
+    with HeartbeatWriter(path, interval=0.0) as hb:
+        seen = -1
+        for step in range(300):
+            hb.beat(step=step)
+            beat = read_heartbeat(path)
+            if beat is not None:  # None = mid-replace read, fine
+                assert beat.step >= seen, (
+                    f"published step regressed: {beat.step} after {seen}"
+                )
+                seen = max(seen, beat.step)
+        assert seen >= 0
+
+
+def test_heartbeat_age_uses_monotonic_clock(tmp_path):
+    """Staleness is duration math on time.monotonic(): a wall-clock jump
+    must not age a beat. The stamp must compare against monotonic 'now',
+    not time.time() (which differs from monotonic by decades)."""
+    path = heartbeat_path(tmp_path, "worker", 0)
+    hb = HeartbeatWriter(path)
+    hb.beat(step=1)
+    beat = read_heartbeat(path)
+    assert abs(beat.age()) < 5.0  # same clock domain as the stamp
+    assert not is_stale(path, timeout=5.0)
+    # an explicitly monotonic 'now' also reads fresh
+    assert not is_stale(path, timeout=5.0, now=time.monotonic())
+
+
 # -- json logging --------------------------------------------------------- #
 
 

@@ -624,6 +624,35 @@ def test_volume_namespace_traversal_rejected_and_restart_recovers(tmp_path):
     assert vc2.count() == 1
 
 
+def test_volume_recover_serializes_with_live_mutations(tmp_path):
+    """Race regression (kft lint lock-discipline finding): ``_recover``
+    used to repopulate ``self._volumes`` without the controller lock, so a
+    re-scan racing a live ``create``/``bind`` could interleave with other
+    mutators mid-update. Now recovery holds the lock: while another thread
+    owns it, ``_recover`` must demonstrably wait."""
+    import threading
+
+    from kubeflow_tpu.platform.volumes import VolumeController, VolumeSpec
+
+    root = tmp_path / "vols"
+    vc = VolumeController(str(root))
+    vc.create(VolumeSpec(name="keep", size_mb=7))
+
+    recovered = threading.Event()
+
+    def rescan():
+        vc._recover()
+        recovered.set()
+
+    with vc._lock:  # a mutator mid-critical-section
+        t = threading.Thread(target=rescan, daemon=True)
+        t.start()
+        assert not recovered.wait(0.2), "_recover entered without the lock"
+    t.join(timeout=5)
+    assert recovered.is_set()
+    assert vc.get("keep").size_mb == 7  # rescan kept the durable volume
+
+
 def test_dashboard_job_post_rejects_non_job_kinds(cluster):
     with DashboardServer(cluster) as dash:
         req = urllib.request.Request(
