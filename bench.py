@@ -1012,6 +1012,128 @@ def bench_engine() -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# config 7b (beyond BASELINE): pipelined-decode microbench — device-resident
+# carry + one-chunk-ahead dispatch (serve/engine.py pipeline_depth=1) vs the
+# inline per-chunk-H2D/D2H loop (pipeline_depth=0), dense AND paged. Runs on
+# the CPU backend too: the host-overhead gap the pipeline removes exists on
+# any backend, just with different magnitudes.
+# --------------------------------------------------------------------------- #
+
+
+def bench_engine_decode() -> dict:
+    """tokens/s + decode-gap for ``pipeline_depth`` 0/1, dense and paged.
+
+    The workload is pure decode steady state (short prompts, long budgets,
+    all rows admitted up front), so the measured delta is exactly what the
+    tentpole targets: per-chunk D2H sync + per-row H2D + host postprocess
+    dead time between device chunks.
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+    from kubeflow_tpu.serve.engine import LMEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = TransformerConfig(
+        vocab_size=32768,
+        d_model=1024 if on_tpu else 128,
+        n_layers=12 if on_tpu else 2,
+        n_heads=16 if on_tpu else 4,
+        d_ff=4096 if on_tpu else 256,
+        causal=True,
+        attn_impl="flash" if on_tpu else "reference",
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    n_req, max_new = 8, 64
+    rng = np.random.default_rng(0)
+    requests = [
+        [int(t) for t in rng.integers(2, cfg.vocab_size, size=int(n))]
+        for n in rng.integers(8, 28, size=n_req)
+    ]
+
+    def run(depth: int, paged: bool) -> dict:
+        kw: dict = dict(
+            max_batch=n_req, max_seq=128, chunk_steps=8,
+            prefill_buckets=(32,), eos_id=1, pipeline_depth=depth,
+        )
+        if paged:
+            kw.update(kv_pool_tokens=128 * (n_req + 1), page_size=32)
+        eng = LMEngine(model, cfg, params, **kw).start()
+        try:
+            eng.submit(requests[0][:8], max_new_tokens=max_new)  # compile
+            outs: dict[int, list[int]] = {}
+
+            def worker(i):
+                outs[i] = eng.submit(requests[i], max_new_tokens=max_new)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(n_req)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(600)
+            dt = time.perf_counter() - t0
+            tokens = sum(len(v) for v in outs.values())
+            return {
+                "tokens_per_s": round(tokens / dt, 1),
+                "tokens": tokens,
+                "seconds": round(dt, 3),
+                "chunks": eng.stats["chunks"],
+                "carry_uploads": eng.overlap["carry_uploads"],
+                "decode_gap_ms": round(eng.overlap["decode_gap_ms"], 3),
+                "d2h_drain_ms": round(eng.overlap["d2h_drain_ms"], 3),
+                "slot_occupancy": round(eng.overlap["slot_occupancy"], 3),
+            }
+        finally:
+            eng.stop()
+
+    dense = {d: run(d, paged=False) for d in (0, 1)}
+    paged = {d: run(d, paged=True) for d in (0, 1)}
+    speed = (
+        dense[1]["tokens_per_s"] / dense[0]["tokens_per_s"]
+        if dense[0]["tokens_per_s"]
+        else None
+    )
+    return {
+        "metric": "engine_decode_pipelined_tokens_per_s",
+        "value": dense[1]["tokens_per_s"],
+        "unit": "tokens/s",
+        "vs_baseline": round(speed, 3) if speed else None,
+        "detail": {
+            "requests": n_req,
+            "max_new_tokens": max_new,
+            "chunk_steps": 8,
+            "model": ("1024d x 12L" if on_tpu else "tiny-cpu"),
+            "dense_inline_depth0": dense[0],
+            "dense_pipelined_depth1": dense[1],
+            "paged_inline_depth0": paged[0],
+            "paged_pipelined_depth1": paged[1],
+            "paged_speedup": (
+                round(paged[1]["tokens_per_s"] / paged[0]["tokens_per_s"], 3)
+                if paged[0]["tokens_per_s"]
+                else None
+            ),
+            "baseline_is": (
+                "identical engine + workload at pipeline_depth=0: per-chunk "
+                "H2D of every per-row array, blocking D2H before the next "
+                "dispatch, host postprocess as dead bus time"
+            ),
+        },
+    }
+
+
+# --------------------------------------------------------------------------- #
 # config 8 (beyond BASELINE): training hot-loop overlap — device prefetch +
 # async metric drain + in-graph gradient accumulation (train/prefetch.py).
 # Baseline = the same Trainer fully synchronous (prefetch_depth=0), the
@@ -1108,11 +1230,31 @@ def _probe_backend(timeout_s: float = 120.0) -> str:
     return probe_backend(timeout_s)
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
     device_benches = (
         bench_mnist, bench_resnet, bench_bert, bench_serving, bench_generate,
-        bench_engine, bench_train_overlap,
+        bench_engine, bench_engine_decode, bench_train_overlap,
     )
+    all_benches = (
+        bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving,
+        bench_generate, bench_engine, bench_engine_decode,
+        bench_train_overlap,
+    )
+    # `python bench.py engine_decode [...]` runs just the named configs
+    # (names = bench_* suffixes); no args runs the whole suite + headline
+    argv = sys.argv[1:] if argv is None else argv
+    by_name = {fn.__name__.removeprefix("bench_"): fn for fn in all_benches}
+    if argv:
+        unknown = [a for a in argv if a not in by_name]
+        if unknown:
+            print(
+                f"unknown bench(es) {unknown}; choose from "
+                f"{sorted(by_name)}", file=sys.stderr,
+            )
+            return 2
+        selected = tuple(by_name[a] for a in argv)
+    else:
+        selected = all_benches
     backend = _probe_backend()
     # AFTER the probe (probe-first contract: no in-process jax before the
     # subprocess liveness check): persist XLA compiles so cold_start_s
@@ -1123,10 +1265,7 @@ def main() -> int:
     enable_compilation_cache()
     alive = backend != "unreachable"
     results: list[dict] = []
-    for fn in (
-        bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving,
-        bench_generate, bench_engine, bench_train_overlap,
-    ):
+    for fn in selected:
         if fn in device_benches and not alive:
             r = {
                 "metric": fn.__name__.replace("bench_", "") + "_unavailable",
@@ -1153,6 +1292,9 @@ def main() -> int:
             }
         results.append(r)
         print(json.dumps(r), flush=True)
+
+    if argv:
+        return 0  # single-config runs emit their JSON lines, no headline
 
     if alive:
         import jax

@@ -68,6 +68,8 @@ MODELMESH_LOAD_FAILURES_TOTAL = "kft_modelmesh_load_failures_total"
 BATCHER_BATCHES = "kubeflow_tpu_batcher_batches"
 BATCHER_INSTANCES = "kubeflow_tpu_batcher_instances"
 BATCHER_MEAN_OCCUPANCY = "kubeflow_tpu_batcher_mean_occupancy"
+#: gauge{model} — co-batched failures re-run per caller (offender isolation)
+BATCHER_FAIL_ISOLATIONS = "kubeflow_tpu_batcher_fail_isolations"
 #: dataplane request metrics (ModelServer /metrics exposition)
 REQUESTS_TOTAL = "kubeflow_tpu_requests_total"
 LATENCY_P50_MS = "kubeflow_tpu_latency_p50_ms"
@@ -77,3 +79,14 @@ LATENCY_P99_MS = "kubeflow_tpu_latency_p99_ms"
 ENGINE_ACTIVE_ROWS = "kubeflow_tpu_engine_active_rows"
 ENGINE_PREFIX = "kubeflow_tpu_engine_"
 ENGINE_KV_PREFIX = "kubeflow_tpu_engine_kv_"
+#: pipelined-decode overlap gauges (serve/engine.py `overlap` dict):
+#: host time between chunk dispatches — the dead bus time the pipeline
+#: exists to remove
+ENGINE_DECODE_GAP_MS = "kft_engine_decode_gap_ms"
+#: token-drain D2H sync time per chunk (overlapped by the next chunk)
+ENGINE_D2H_DRAIN_MS = "kft_engine_d2h_drain_ms"
+#: counter — carry epoch re-uploads; grows with admissions/retirements,
+#: NOT with chunks (steady-state decode performs zero per-chunk H2D)
+ENGINE_CARRY_UPLOADS_TOTAL = "kft_engine_carry_uploads_total"
+#: EWMA occupied-row fraction at chunk dispatch
+ENGINE_SLOT_OCCUPANCY = "kft_engine_slot_occupancy"

@@ -49,6 +49,7 @@ from kubeflow_tpu.serve.modelmesh import MeshBackedModel, ModelMesh
 from kubeflow_tpu.serve.engine import (
     EngineOverloaded,
     LMEngine,
+    LMEngineConfig,
     LMEngineModel,
 )
 
@@ -66,6 +67,7 @@ __all__ = [
     "MeshBackedModel",
     "ModelMesh",
     "LMEngine",
+    "LMEngineConfig",
     "LMEngineModel",
     "EngineOverloaded",
 ]

@@ -43,7 +43,7 @@ class Batcher:
         self._queue: list[tuple[list[Any], asyncio.Future]] = []
         self._flush_task: asyncio.Task | None = None
         self._lock = asyncio.Lock()
-        self.stats = {"batches": 0, "instances": 0}
+        self.stats = {"batches": 0, "instances": 0, "fail_isolations": 0}
 
     @property
     def mean_occupancy(self) -> float:
@@ -101,12 +101,18 @@ class Batcher:
                 return
             # Isolate the offender: re-run each caller's instances alone so
             # one malformed request doesn't fail every co-batched one.
+            # Succeeded re-runs still count toward "instances" — skipping
+            # them silently deflated mean_occupancy after any co-batched
+            # failure — and the isolation event itself is counted so
+            # operators can see offender-isolation churn on /metrics.
+            self.stats["fail_isolations"] += 1
             for instances, fut in queue:
                 if fut.done():
                     continue
                 try:
                     fut.set_result(list(await self._handler(list(instances))))
                     self.stats["batches"] += 1
+                    self.stats["instances"] += len(instances)
                 except Exception as per:
                     fut.set_exception(per)
             return

@@ -21,18 +21,36 @@ shapes):
 - **Static shapes everywhere**: prompts pad to prefill buckets; the chunk
   program is compiled once per (max_batch, chunk) — admission never
   recompiles anything.
+- **Pipelined decode** (``pipeline_depth=1``, the default): the decode
+  steady state performs ZERO per-chunk host round-trips. The per-row
+  scheduling arrays (last token, generation counts, activity, budgets,
+  temperatures) live on device as a *carry* threaded from one chunk
+  dispatch into the next, and chunk N+1 is dispatched *before* chunk N's
+  tokens are drained D2H — JAX async dispatch overlaps the host-side
+  drain/postprocess of chunk N with chunk N+1's device compute (the same
+  gap vLLM's async engine loop closes for GPUs). Admissions, prefill
+  completions, cancellations and page reallocation are *epochs*: they
+  dirty the carry, force a merged drain of the in-flight chunk (with the
+  speculative results of retired rows masked out), and re-upload the
+  per-row arrays ONCE — the only H2D left. ``pipeline_depth=0`` keeps the
+  old fully-synchronous loop selectable for parity testing and debugging.
 
 Correctness contract (pinned by tests/test_engine.py): a request's tokens
 are IDENTICAL to what the whole-batch ``make_generate_fn`` path produces
-for the same prompt under greedy decoding — continuous batching is a
-scheduling optimization, never a numerics change.
+for the same prompt under greedy decoding — continuous batching *and* the
+pipelined carry are scheduling optimizations, never a numerics change.
+The speculative chunk is safe because every per-row liveness decision the
+device needs (EOS, budget exhaustion) is already computed in-graph; only
+host-initiated transitions (admit/cancel/prefill-activate) require an
+epoch, and those are exactly the points that re-upload.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from dataclasses import dataclass, field
+import time
+from dataclasses import dataclass, field, replace as _dc_replace
 from typing import Any
 
 import jax
@@ -44,7 +62,62 @@ from kubeflow_tpu.models.transformer import (
     TransformerLM,
     init_kv_cache,
 )
-from kubeflow_tpu.serve.generate import LMRuntimeModel, decode_kv_mask
+from kubeflow_tpu.serve.generate import (
+    LMRuntimeModel,
+    decode_kv_mask,
+    sample_logits as _sample,
+)
+
+#: idle park bound — every waker (submit, stream-cancel, stop) sets
+#: ``_work``, so this timeout is only a belt-and-braces sweep, not a poll
+_IDLE_PARK_S = 5.0
+
+
+@dataclass
+class LMEngineConfig:
+    """Engine tuning knobs, bundled so deployments can pass one object
+    (and so the pipeline knob has a named home). Every field can also be
+    given directly to ``LMEngine(...)`` as a keyword override.
+
+    ``pipeline_depth``: 1 (default) runs the pipelined decode loop —
+    device-resident carry + one-chunk-ahead dispatch; 0 selects the
+    fully-synchronous inline loop (per-chunk H2D/D2H) for parity testing
+    and debugging. Depths > 1 are rejected: a second speculative chunk
+    would decode on a carry the host can no longer merge-edit cheaply,
+    for no additional overlap (one chunk already hides the drain)."""
+
+    max_batch: int = 8
+    max_seq: int = 256
+    chunk_steps: int = 8
+    prefill_buckets: tuple[int, ...] = (32, 128)
+    eos_id: int = 1
+    pad_id: int = 0
+    seed: int = 0
+    max_queue: int = 64
+    prefix_cache_entries: int = 0
+    prefix_cache_tokens: int | None = None
+    prefill_chunk: int | None = None
+    mesh: Any = None
+    rules: Any = None
+    kv_pool_tokens: int | None = None
+    page_size: int = 64
+    pipeline_depth: int = 1
+
+
+@dataclass
+class _PendingChunk:
+    """One dispatched-but-undrained decode chunk: device handles to its
+    outputs plus the dispatch-time slot snapshot, so the drain can mask
+    out speculative results of rows retired while the chunk was in
+    flight (cancellation, re-admission)."""
+
+    toks: Any          # (B, T) device tokens
+    valid: Any         # (B, T) device validity
+    last_tok: Any      # (B,) post-chunk carry token
+    gen_count: Any     # (B,) post-chunk generation counts
+    active_out: Any    # (B,) post-chunk liveness
+    active_in: Any     # (B,) liveness AT DISPATCH (drain credit gate)
+    slots: list        # _Request-per-row snapshot at dispatch
 
 
 @dataclass
@@ -94,22 +167,32 @@ class LMEngine:
         cfg: TransformerConfig,
         params,
         *,
-        max_batch: int = 8,
-        max_seq: int = 256,
-        chunk_steps: int = 8,
-        prefill_buckets: tuple[int, ...] = (32, 128),
-        eos_id: int = 1,
-        pad_id: int = 0,
-        seed: int = 0,
-        max_queue: int = 64,
-        prefix_cache_entries: int = 0,
-        prefix_cache_tokens: int | None = None,
-        prefill_chunk: int | None = None,
-        mesh=None,
-        rules=None,
-        kv_pool_tokens: int | None = None,
-        page_size: int = 64,
+        config: LMEngineConfig | None = None,
+        **overrides,
     ):
+        if config is None:
+            config = LMEngineConfig()
+        if overrides:
+            # unknown keys raise TypeError naming the offender — the same
+            # contract the old explicit keyword list gave callers
+            config = _dc_replace(config, **overrides)
+        self.engine_config = config
+        max_batch, max_seq = config.max_batch, config.max_seq
+        chunk_steps = config.chunk_steps
+        prefill_buckets = config.prefill_buckets
+        eos_id, pad_id, seed = config.eos_id, config.pad_id, config.seed
+        max_queue = config.max_queue
+        prefix_cache_entries = config.prefix_cache_entries
+        prefix_cache_tokens = config.prefix_cache_tokens
+        prefill_chunk = config.prefill_chunk
+        mesh, rules = config.mesh, config.rules
+        kv_pool_tokens, page_size = config.kv_pool_tokens, config.page_size
+        if config.pipeline_depth not in (0, 1):
+            raise ValueError(
+                "pipeline_depth must be 0 (inline) or 1 (one-chunk-ahead); "
+                f"got {config.pipeline_depth}"
+            )
+        self.pipeline_depth = config.pipeline_depth
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
         from kubeflow_tpu.core.compcache import enable_compilation_cache
@@ -230,7 +313,24 @@ class LMEngine:
         self.stats = {
             "admitted": 0, "completed": 0, "chunks": 0,
             "max_concurrent": 0, "prefix_hits": 0, "prefix_tokens_reused": 0,
-            "prefill_pieces": 0,
+            "prefill_pieces": 0, "idle_wakes": 0,
+        }
+        # pipelined-decode state: the device-resident carry of per-row
+        # scheduling arrays, its dirtiness (host edits pending merge), and
+        # the paged horizon bookkeeping for speculative chunks. ``overlap``
+        # holds the pipeline gauges exported as kft_engine_* (obs/names.py).
+        self._carry: dict[str, Any] | None = None
+        self._carry_dirty = True
+        self._carry_chunks = 0   # chunks dispatched since last upload
+        self._carry_h0 = 0       # paged: max(real_len+gen_count) at upload
+        self._carry_hcap = 0     # paged: max(real_len+budget) at upload
+        self._carry_pages_w = 0  # paged: uploaded table width (pages)
+        self._last_dispatch: float | None = None
+        self.overlap = {
+            "decode_gap_ms": 0.0,   # EWMA host time between chunk dispatches
+            "d2h_drain_ms": 0.0,    # EWMA token-drain D2H sync time
+            "carry_uploads": 0,     # epoch re-uploads (~admissions, not chunks)
+            "slot_occupancy": 0.0,  # EWMA occupied-row fraction at dispatch
         }
         if self.paged:
             # pre-initialized: /metrics iterates this dict from another
@@ -845,6 +945,9 @@ class LMEngine:
             "req": req, "rest": rest, "base": base, "C": C,
             "n_pieces": n_pieces, "piece": 0,
         }
+        # admission epoch: the per-row mirrors (and paged table) changed —
+        # the next dispatch must merge+re-upload the carry
+        self._carry_dirty = True
         if n_pieces == 1:
             # single-piece prompts admit synchronously (no interleaving to
             # gain); multi-piece rows take ONE piece per loop iteration via
@@ -901,6 +1004,9 @@ class LMEngine:
         else:
             self.active[row] = True
             self.gen_count[row] = 1
+            # activation epoch: the row joins the device batch at the next
+            # carry upload
+            self._carry_dirty = True
 
     def _advance_prefills(self) -> None:
         for row in list(self._prefilling):
@@ -910,13 +1016,21 @@ class LMEngine:
                 continue
             self._advance_prefill(row)
 
-    def _finish(self, row: int) -> None:
+    def _finish(self, row: int, *, carry_stale: bool = True) -> None:
         req = self._slots[row]
         self._slots[row] = None
         self.active[row] = False
         self._prefilling.pop(row, None)
         if self.paged:
             self.pager.free(row)
+        # ``carry_stale=False`` is the drain's EOS/budget retirement: the
+        # device carry already gates the row in-graph (active=False after
+        # EOS; gen_count==budget masks it live=False), so no re-upload is
+        # needed and steady-state completions stay epoch-free. Host-only
+        # retirements (cancellation, failed admission) leave the device
+        # thinking the row is live → dirty the carry.
+        if carry_stale:
+            self._carry_dirty = True
         if req is not None:
             # count BEFORE done.set(): callers may read/reset stats the
             # moment their submit returns (warmup does)
@@ -950,81 +1064,218 @@ class LMEngine:
                 req.finish()
 
     def _loop_inner(self) -> None:
+        pending: _PendingChunk | None = None
         while not self._stop.is_set():
             self._admit_all()
             self._advance_prefills()  # one piece per prefilling row
             if not self.active.any():
+                if pending is not None:
+                    # burst tail: the speculative chunk outlived its rows
+                    # (host mirrors may also lag it by one chunk) — drain
+                    # it, then re-evaluate
+                    self._drain_chunk(pending)
+                    pending = None
+                    continue
                 if self._prefilling:
                     continue  # keep advancing pieces, don't park
-                # idle: park until a submit arrives
-                self._work.wait(0.05)
+                # idle: park until submit/stream-cancel/stop sets _work —
+                # every waker does, so the long timeout is only a
+                # belt-and-braces sweep, never a 20 Hz poll. Clearing after
+                # the wait cannot lose work: _admit_all re-polls the queue
+                # at the top of the next iteration.
+                self._last_dispatch = None
+                self.stats["idle_wakes"] += 1
+                self._work.wait(_IDLE_PARK_S)
                 self._work.clear()
                 continue
-            self._rng, sub = jax.random.split(self._rng)
-            if self.paged:
-                # read window: the furthest token any ACTIVE row can reach
-                # this chunk, pow2-page-bucketed → bounded program set
-                horizon = int(
-                    (
-                        (self.real_len + self.gen_count)[self.active]
-                    ).max()
-                ) + self.chunk_steps
-                pages_w = self._pages_w(horizon)
-                (
-                    self.cache, tok, gen_count, active, toks, valid
-                ) = self._chunk(
-                    self.cache,
-                    jnp.asarray(self.last_tok),
-                    jnp.asarray(self.real_len),
-                    jnp.asarray(self.gen_count),
-                    jnp.asarray(self.active),
-                    jnp.asarray(self.budget),
-                    jnp.asarray(self.temp),
-                    sub,
-                    jnp.asarray(self.pager.table[:, :pages_w]),
-                )
-            else:
-                (
-                    self.cache, tok, gen_count, active, toks, valid
-                ) = self._chunk(
-                    self.cache,
-                    jnp.asarray(self.last_tok),
-                    jnp.asarray(self.real_len),
-                    jnp.asarray(self.gen_start),
-                    jnp.asarray(self.gen_count),
-                    jnp.asarray(self.active),
-                    jnp.asarray(self.budget),
-                    jnp.asarray(self.temp),
-                    sub,
-                )
-            self.stats["chunks"] += 1
-            # decode boundary: generated tokens must reach the host to
-            # stream to clients — this D2H is the product, not a stall, and
-            # it runs on the engine scheduler thread, never a request thread
-            toks = np.asarray(toks)  # kft: noqa[jax-sync] — sanctioned decode-boundary D2H on the scheduler thread
-            valid = np.asarray(valid)  # kft: noqa[jax-sync] — same decode boundary as toks above
-            # np.array copies: device-array views are read-only, and _admit
-            # writes per-row entries into these
-            self.last_tok = np.array(tok)
-            self.gen_count = np.array(gen_count)
-            device_active = np.asarray(active)  # kft: noqa[jax-sync] — same decode boundary; row liveness must be host-visible to admit/retire
-            for row in range(self.max_batch):
-                req = self._slots[row]
-                if req is None or not self.active[row]:
+            if self.pipeline_depth == 0:
+                # inline parity/debug path: per-chunk H2D upload and an
+                # immediate D2H drain — the pre-pipeline hot loop, kept
+                # selectable so pipelined parity is provable seed-for-seed
+                self._upload_carry()
+                self._drain_chunk(self._dispatch_chunk())
+                continue
+            if self._carry_dirty:
+                if pending is not None:
+                    # merge point: drain the in-flight chunk first so the
+                    # host mirrors are current (retired rows masked out),
+                    # then loop — the drain may free rows/pages admission
+                    # wants before the single merged re-upload
+                    self._drain_chunk(pending)
+                    pending = None
                     continue
-                hit_eos = False
-                fresh: list[int] = []
-                for j in range(self.chunk_steps):
-                    if len(req.tokens) + len(fresh) >= req.max_new_tokens:
-                        break
-                    if not valid[row, j]:
-                        hit_eos = True
-                        break
-                    fresh.append(int(toks[row, j]))
-                req.push(fresh)
-                self.active[row] = bool(device_active[row])
-                if hit_eos or len(req.tokens) >= req.max_new_tokens:
-                    self._finish(row)
+                self._upload_carry()
+            if pending is not None and self._all_may_retire():
+                # end-of-burst: every active row can exhaust its budget
+                # inside the in-flight chunk, so a speculative dispatch
+                # would likely decode only dead rows — drain first instead
+                # and let the retirements land (EOS tails still cost at
+                # most one dead chunk; budgets are host-knowable, EOS
+                # isn't)
+                self._drain_chunk(pending)
+                pending = None
+                continue
+            # one-chunk-ahead: dispatch N+1 on the device carry BEFORE
+            # draining N, so N's token D2H + host postprocess overlap
+            # N+1's device compute
+            nxt = self._dispatch_chunk()
+            if pending is not None:
+                self._drain_chunk(pending)
+            pending = nxt
+
+    # -- pipelined decode: carry upload / dispatch / drain ------------------- #
+
+    def _all_may_retire(self) -> bool:
+        """True when every host-visible active row could exhaust its token
+        budget within ONE more chunk. The host mirrors lag the in-flight
+        chunk by exactly chunk_steps, so remaining ≤ chunk_steps means the
+        undrained chunk may already retire the whole batch."""
+        act = self.active
+        if not act.any():
+            return True
+        remaining = (self.budget - self.gen_count)[act]
+        return bool((remaining <= self.chunk_steps).all())
+
+    def _ewma(self, key: str, value: float, alpha: float = 0.2) -> None:
+        cur = self.overlap[key]
+        self.overlap[key] = value if cur == 0.0 else (
+            (1.0 - alpha) * cur + alpha * value
+        )
+
+    def _upload_carry(self) -> None:
+        """Upload the per-row scheduling arrays from the host mirrors —
+        the ONE H2D an epoch pays. Must only run with the mirrors current
+        (no undrained chunk): the pipelined loop drains before editing."""
+        c: dict[str, Any] = {
+            "last_tok": jnp.asarray(self.last_tok),
+            "gen_count": jnp.asarray(self.gen_count),
+            "active": jnp.asarray(self.active),
+            "real_len": jnp.asarray(self.real_len),
+            "budget": jnp.asarray(self.budget),
+            "temp": jnp.asarray(self.temp),
+        }
+        if self.paged:
+            act = self.active
+            if act.any():
+                reach = self.real_len + self.gen_count
+                self._carry_h0 = int(reach[act].max())
+                self._carry_hcap = int((self.real_len + self.budget)[act].max())
+            else:
+                self._carry_h0 = self._carry_hcap = 0
+            w = self._pages_w(
+                max(min(self._carry_h0 + self.chunk_steps,
+                        self._carry_hcap), 1)
+            )
+            # memoized device mirror: unchanged table + same width = no H2D
+            c["table"] = self.pager.device_table(w)
+            self._carry_pages_w = w
+        else:
+            c["gen_start"] = jnp.asarray(self.gen_start)
+        self._carry = c
+        self._carry_dirty = False
+        self._carry_chunks = 0
+        self.overlap["carry_uploads"] += 1
+
+    def _dispatch_chunk(self) -> _PendingChunk:
+        """Dispatch one decode chunk on the device carry (async — returns
+        device handles immediately) and thread the returned per-row arrays
+        into the carry for the next dispatch: the steady state performs
+        zero per-chunk H2D of per-row arrays."""
+        now = time.perf_counter()
+        if self._last_dispatch is not None:
+            self._ewma("decode_gap_ms", (now - self._last_dispatch) * 1e3)
+        self._last_dispatch = now
+        self._ewma(
+            "slot_occupancy",
+            sum(s is not None for s in self._slots) / self.max_batch,
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        c = self._carry
+        active_in = c["active"]
+        if self.paged:
+            # page-horizon growth across speculative chunks: active rows
+            # advance ≤ chunk_steps per chunk, so this bound covers every
+            # write/read this chunk can reach; when it crosses a pow2 page
+            # bucket, widen the device table (the host table is constant
+            # within an epoch, so widening mid-flight is safe)
+            horizon = min(
+                self._carry_h0 + (self._carry_chunks + 1) * self.chunk_steps,
+                self._carry_hcap,
+            )
+            w = self._pages_w(max(horizon, 1))
+            if w > self._carry_pages_w:
+                c["table"] = self.pager.device_table(w)
+                self._carry_pages_w = w
+                self.overlap["carry_uploads"] += 1
+            (
+                self.cache, tok, gen_count, active, toks, valid
+            ) = self._chunk(
+                self.cache, c["last_tok"], c["real_len"], c["gen_count"],
+                c["active"], c["budget"], c["temp"], sub, c["table"],
+            )
+        else:
+            (
+                self.cache, tok, gen_count, active, toks, valid
+            ) = self._chunk(
+                self.cache, c["last_tok"], c["real_len"], c["gen_start"],
+                c["gen_count"], c["active"], c["budget"], c["temp"], sub,
+            )
+        c["last_tok"], c["gen_count"], c["active"] = tok, gen_count, active
+        self._carry_chunks += 1
+        self.stats["chunks"] += 1
+        return _PendingChunk(
+            toks=toks, valid=valid, last_tok=tok, gen_count=gen_count,
+            active_out=active, active_in=active_in,
+            slots=list(self._slots),
+        )
+
+    def _drain_chunk(self, p: _PendingChunk) -> None:
+        """Bring one chunk's results to the host, credit tokens to the
+        requests that were resident at dispatch, lazily refresh the host
+        mirrors, and retire rows that hit EOS or budget. Results of rows
+        retired while the chunk was speculatively in flight are masked
+        out: their tokens belong to a request that no longer owns the
+        row."""
+        t0 = time.perf_counter()
+        # decode boundary: generated tokens must reach the host to stream
+        # to clients — this D2H is the product, not a stall; it runs on the
+        # engine scheduler thread (never a request thread) and, pipelined,
+        # overlaps the NEXT chunk's device compute
+        toks, valid, act_in, last, genc, act_out = (
+            np.asarray(x)  # kft: noqa[jax-sync] — sanctioned decode-boundary D2H on the scheduler thread; overlapped by the in-flight next chunk
+            for x in (p.toks, p.valid, p.active_in, p.last_tok,
+                      p.gen_count, p.active_out)
+        )
+        self._ewma("d2h_drain_ms", (time.perf_counter() - t0) * 1e3)
+        for row in range(self.max_batch):
+            req = p.slots[row]
+            if req is None or not act_in[row]:
+                continue  # free or still prefilling at dispatch: no tokens
+            if self._slots[row] is not req:
+                # retired (cancelled / re-admitted) while this chunk was in
+                # flight: mask its speculative results — mirrors for this
+                # row were rewritten by the host edit and must stand
+                continue
+            hit_eos = False
+            fresh: list[int] = []
+            for j in range(self.chunk_steps):
+                if len(req.tokens) + len(fresh) >= req.max_new_tokens:
+                    break
+                if not valid[row, j]:
+                    hit_eos = True
+                    break
+                fresh.append(int(toks[row, j]))
+            req.push(fresh)
+            # lazy mirror refresh from the drained outputs — the only place
+            # host state learns device progress; per-row (not wholesale) so
+            # rows edited by admit/prefill keep their newer host values
+            self.last_tok[row] = last[row]
+            self.gen_count[row] = genc[row]
+            self.active[row] = bool(act_out[row])
+            if hit_eos or len(req.tokens) >= req.max_new_tokens:
+                # device-visible retirement: the carry already gates this
+                # row in-graph, so no epoch is burned
+                self._finish(row, carry_stale=False)
 
 
 class _AdmittedStream:
@@ -1058,13 +1309,6 @@ class _AdmittedStream:
             self._release_once()
 
 
-def _sample(logits, rng, temperature):
-    greedy = jnp.argmax(logits, axis=-1)
-    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-    drawn = jax.random.categorical(rng, scaled, axis=-1)
-    return jnp.where(temperature <= 0.0, greedy, drawn)
-
-
 class LMEngineModel(LMRuntimeModel):
     """Engine-backed serving model: the ``causal-lm`` runtime's data path
     (tokenizer, preprocess, postprocess) with continuous batching
@@ -1076,7 +1320,7 @@ class LMEngineModel(LMRuntimeModel):
         self, name, storage_path=None, *, max_batch=8, max_seq=None,
         chunk_steps=8, prefix_cache_entries=0, prefix_cache_tokens=None,
         prefill_chunk=None, mesh=None, rules=None,
-        kv_pool_tokens=None, page_size=64, **kwargs,
+        kv_pool_tokens=None, page_size=64, pipeline_depth=1, **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
@@ -1088,6 +1332,7 @@ class LMEngineModel(LMRuntimeModel):
         self._engine_prefill_chunk = prefill_chunk
         self._engine_pool_tokens = kv_pool_tokens
         self._engine_page_size = page_size
+        self._engine_pipeline_depth = pipeline_depth
         self._engine_max_seq = max_seq or (
             self.buckets.seq_lens[-1] + self.max_new_tokens
         )
@@ -1126,6 +1371,7 @@ class LMEngineModel(LMRuntimeModel):
             rules=self._engine_rules,
             kv_pool_tokens=self._engine_pool_tokens,
             page_size=self._engine_page_size,
+            pipeline_depth=self._engine_pipeline_depth,
         ).start()
         return True
 
@@ -1200,6 +1446,8 @@ class LMEngineModel(LMRuntimeModel):
         # gauges, hit rates) — counters restart at zero
         for key in eng.stats:
             eng.stats[key] = 0
+        for key in eng.overlap:
+            eng.overlap[key] = 0 if key == "carry_uploads" else 0.0
 
     def _submit_row(self, row) -> dict:
         toks = self.engine.submit(

@@ -66,6 +66,20 @@ def decode_kv_mask(kpos, prompt_len, gen_start, slot, window=None):
     return prompt_keep | gen_keep
 
 
+def sample_logits(logits, rng, temperature):
+    """Per-row greedy/temperature sampling over (B, V) logits.
+
+    Temperature is PER ROW (B,): co-batched greedy and sampling requests
+    must each get what they asked for. Shared by ``make_generate_fn`` and
+    the engine's chunk/prefill programs (the carry-friendly step seam), so
+    the two decode paths cannot diverge in sampling semantics — the
+    engine's token-parity contract against this module depends on it."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    drawn = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, drawn)
+
+
 def make_generate_fn(
     model: TransformerLM,
     cfg: TransformerConfig,
@@ -77,13 +91,7 @@ def make_generate_fn(
     """Builds ``(params, prompt, prompt_len, rng, temperature) → tokens``:
     prefill + scan-decode, jittable per (batch, prefill_len) bucket."""
 
-    def sample(logits, rng, temperature):
-        # temperature is PER ROW (B,): co-batched greedy and sampling
-        # requests must each get what they asked for
-        greedy = jnp.argmax(logits, axis=-1)
-        scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-        drawn = jax.random.categorical(rng, scaled, axis=-1)
-        return jnp.where(temperature <= 0.0, greedy, drawn)
+    sample = sample_logits
 
     def generate(params, prompt, prompt_len, rng, temperature):
         B, P = prompt.shape

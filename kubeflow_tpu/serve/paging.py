@@ -55,6 +55,13 @@ class PageAllocator:
         self._free: list[int] = list(range(self.num_pages - 1, 0, -1))
         self._owned: dict[int, list[int]] = {}  # row → pages
         self.table = np.zeros((max_batch, max_pages_per_row), np.int32)
+        #: device mirror bookkeeping: ``version`` bumps on every alloc/free,
+        #: and ``device_table`` memoizes one upload per (version, width) so
+        #: the pipelined decode loop pays H2D only on real table changes or
+        #: horizon widenings — never per chunk.
+        self.version = 0
+        self.device_uploads = 0
+        self._dev: dict[int, tuple[int, object]] = {}  # width → (ver, arr)
 
     # ------------------------------------------------------------------ #
 
@@ -88,12 +95,28 @@ class PageAllocator:
         self._owned[row] = pages
         self.table[row, :] = 0
         self.table[row, : len(pages)] = pages
+        self.version += 1
 
     def free(self, row: int) -> None:
         pages = self._owned.pop(row, None)
         if pages:
             self._free.extend(pages)
             self.table[row, :] = 0
+            self.version += 1
+
+    def device_table(self, width: int):
+        """Device-resident ``table[:, :width]``, re-uploaded only when the
+        host table changed since the last upload at this width. The width
+        set is pow2-bucketed by the engine, so the memo stays small; stale
+        widths keep their old arrays (tiny int32 slabs) until re-read."""
+        import jax.numpy as jnp  # deferred: the allocator itself is host-only
+
+        ver, arr = self._dev.get(width, (-1, None))
+        if ver != self.version or arr is None:
+            arr = jnp.asarray(self.table[:, :width])
+            self._dev[width] = (self.version, arr)
+            self.device_uploads += 1
+        return arr
 
     def stats(self) -> dict:
         return {

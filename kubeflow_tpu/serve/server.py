@@ -51,6 +51,10 @@ BATCHER_MEAN_OCCUPANCY = prom.REGISTRY.gauge(
     names.BATCHER_MEAN_OCCUPANCY,
     "mean instances per handler call (batch fill)", ("model",),
 )
+BATCHER_FAIL_ISOLATIONS = prom.REGISTRY.gauge(
+    names.BATCHER_FAIL_ISOLATIONS,
+    "co-batched failures re-run per caller (offender isolation)", ("model",),
+)
 
 
 def _batcher_collector(name: str, batcher: Batcher):
@@ -58,6 +62,9 @@ def _batcher_collector(name: str, batcher: Batcher):
         BATCHER_BATCHES.labels(model=name).set(batcher.stats["batches"])
         BATCHER_INSTANCES.labels(model=name).set(batcher.stats["instances"])
         BATCHER_MEAN_OCCUPANCY.labels(model=name).set(batcher.mean_occupancy)
+        BATCHER_FAIL_ISOLATIONS.labels(model=name).set(
+            batcher.stats["fail_isolations"]
+        )
 
     return collect
 
@@ -468,6 +475,10 @@ class ModelServer:
                 f'{names.BATCHER_MEAN_OCCUPANCY}{{model="{name}"}} '
                 f"{b.mean_occupancy:.3f}"
             )
+            lines.append(
+                f'{names.BATCHER_FAIL_ISOLATIONS}{{model="{name}"}} '
+                f'{b.stats["fail_isolations"]}'
+            )
         # engine-backed models export their scheduler gauges too
         for name in self.dataplane.list_models():
             model = self.dataplane.get(name)
@@ -482,6 +493,24 @@ class ModelServer:
                 f'{names.ENGINE_ACTIVE_ROWS}{{model="{name}"}} '
                 f"{int(eng.active.sum())}"
             )
+            ov = getattr(eng, "overlap", None)
+            if ov is not None:  # pipelined-decode overlap gauges
+                lines.append(
+                    f'{names.ENGINE_DECODE_GAP_MS}{{model="{name}"}} '
+                    f'{ov["decode_gap_ms"]:.3f}'
+                )
+                lines.append(
+                    f'{names.ENGINE_D2H_DRAIN_MS}{{model="{name}"}} '
+                    f'{ov["d2h_drain_ms"]:.3f}'
+                )
+                lines.append(
+                    f'{names.ENGINE_CARRY_UPLOADS_TOTAL}{{model="{name}"}} '
+                    f'{ov["carry_uploads"]}'
+                )
+                lines.append(
+                    f'{names.ENGINE_SLOT_OCCUPANCY}{{model="{name}"}} '
+                    f'{ov["slot_occupancy"]:.3f}'
+                )
             pager = getattr(eng, "pager", None)
             if pager is not None:  # paged-KV engines: live pool pressure
                 for key, val in pager.stats().items():

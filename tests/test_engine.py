@@ -924,6 +924,207 @@ def test_engine_gqa_with_prefix_cache(model_and_params):
         eng.stop()
 
 
+# ------------------------------------------------- pipelined decode (carry)
+
+
+def test_pipelined_inline_token_parity_under_churn(model_and_params):
+    """The tentpole contract: pipeline_depth=1 (device-resident carry +
+    one-chunk-ahead dispatch) emits byte-identical token streams to the
+    inline pipeline_depth=0 path for the same seed, under admission churn
+    (7 staggered requests through 3 rows), chunked prefill (prefill_chunk
+    splits the long prompts), and a mid-stream cancellation."""
+    model, params = model_and_params
+    rng = np.random.default_rng(71)
+    # mixed lengths: several short, two long enough for multi-piece prefill
+    prompts = _prompts(rng, 5, lo=3, hi=14) + [
+        [int(x) for x in rng.integers(2, CFG.vocab_size, size=n)]
+        for n in (34, 41)
+    ]
+
+    def run_mode(depth):
+        eng = LMEngine(
+            model, CFG, params, max_batch=3, max_seq=96, chunk_steps=4,
+            prefill_buckets=(48,), eos_id=EOS, prefill_chunk=16, seed=7,
+            pipeline_depth=depth,
+        ).start()
+        outs: dict[int, list[int]] = {}
+        errors: list[Exception] = []
+
+        def worker(i):
+            try:
+                time.sleep(0.02 * i)  # staggered arrivals → admission churn
+                outs[i] = eng.submit(prompts[i], max_new_tokens=12)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            # mid-stream cancellation riding along: read one chunk, walk away
+            stream = eng.stream(prompts[0], max_new_tokens=12)
+            next(iter(stream))
+            stream.close()
+            for t in threads:
+                t.join(180)
+            stats = dict(eng.stats)
+            uploads = eng.overlap["carry_uploads"]
+        finally:
+            eng.stop()
+        assert not errors, errors
+        return outs, stats, uploads
+
+    pipe, pipe_stats, pipe_uploads = run_mode(1)
+    inline, _, _ = run_mode(0)
+    assert len(pipe) == len(prompts)
+    for i in range(len(prompts)):
+        assert pipe[i] == inline[i], (i, pipe[i], inline[i])
+        # and both equal the pinned whole-batch reference (greedy)
+        want = _reference_completion(model, params, prompts[i], 12)
+        assert pipe[i] == want, (i, pipe[i], want)
+    assert pipe_stats["max_concurrent"] >= 2  # churn really happened
+    assert pipe_stats["prefill_pieces"] > len(prompts)  # chunked prefills ran
+    # epochs, not chunks: uploads bounded by admissions/activations, far
+    # below one per chunk once decode is the steady state
+    assert pipe_uploads < pipe_stats["chunks"] + 2 * pipe_stats["admitted"]
+
+
+def test_pipelined_steady_state_uploads_are_epochs_not_chunks(
+    model_and_params,
+):
+    """Acceptance: steady-state decode performs ZERO per-chunk H2D of the
+    per-row arrays — carry uploads grow only on admit/retire/prefill
+    epochs. Finds a request whose decode spans several chunks and shows
+    its upload delta stays at the admission epoch alone."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=2, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS, pipeline_depth=1,
+    ).start()
+    try:
+        rng = np.random.default_rng(73)
+        found = False
+        for ids in _prompts(rng, 40):
+            c0 = eng.stats["chunks"]
+            u0 = eng.overlap["carry_uploads"]
+            out = eng.submit(ids, max_new_tokens=16)
+            dc = eng.stats["chunks"] - c0
+            du = eng.overlap["carry_uploads"] - u0
+            # every submit is one admission epoch (single-piece prefill):
+            # one upload, regardless of how many chunks it decoded for
+            assert du <= 2, (ids, du, dc)
+            if len(out) >= 10:  # ≥5 chunks at chunk_steps=2
+                assert dc > du, (ids, dc, du)
+                found = True
+                break
+        assert found, "no prompt produced a long enough completion"
+    finally:
+        eng.stop()
+
+
+def test_pipelined_fatal_inflight_chunk_cannot_leak_requests(
+    model_and_params,
+):
+    """If the device dies while a speculative chunk is in flight, every
+    request — including those whose freshest tokens only exist in the
+    undrained chunk — must fail promptly with the real error, and later
+    submits fail fast. No wedged request, no silent dead scheduler."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=2, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS, pipeline_depth=1,
+    ).start()
+    real_chunk = eng._chunk
+    calls = {"n": 0}
+
+    def exploding(*a, **k):
+        calls["n"] += 1
+        if calls["n"] >= 2:  # chunk 1 dispatches fine and stays in flight
+            raise RuntimeError("injected device failure")
+        return real_chunk(*a, **k)
+
+    eng._chunk = exploding
+    errors: dict[int, Exception] = {}
+
+    def worker(i):
+        try:
+            eng.submit([3 + i, 5, 7, 11], max_new_tokens=16, timeout_s=30)
+        except Exception as e:  # noqa: BLE001
+            errors[i] = e
+
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(2)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(25)
+        assert all(not t.is_alive() for t in threads)
+        assert time.monotonic() - t0 < 20  # prompt failure, not a timeout
+        assert len(errors) == 2, "a request leaked past the fatal path"
+        for e in errors.values():
+            assert "injected device failure" in str(e)
+        with pytest.raises(RuntimeError, match="engine is dead"):
+            eng.submit([9, 9, 9], max_new_tokens=4, timeout_s=10)
+    finally:
+        eng.stop()
+
+
+def test_idle_parks_without_busy_wake(model_and_params):
+    """The idle path must PARK on the work event, not poll at 20 Hz: over
+    an idle second the wake-count probe stays flat, and a submit still
+    wakes the loop immediately."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=1, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    try:
+        eng.submit([3, 4, 5], max_new_tokens=4)  # compile + settle
+        time.sleep(0.1)  # let the loop reach the park branch
+        wakes0 = eng.stats["idle_wakes"]
+        time.sleep(1.2)
+        # the old 0.05s poll would add ~24 park entries here
+        assert eng.stats["idle_wakes"] - wakes0 <= 2
+        # and the event wake path still serves promptly
+        t0 = time.monotonic()
+        out = eng.submit([5, 6, 7], max_new_tokens=4, timeout_s=30)
+        assert isinstance(out, list)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        eng.stop()
+
+
+def test_engine_config_object_and_depth_validation(model_and_params):
+    """LMEngineConfig bundles the knobs; unknown overrides and invalid
+    pipeline depths fail loudly."""
+    from kubeflow_tpu.serve.engine import LMEngineConfig
+
+    model, params = model_and_params
+    cfgobj = LMEngineConfig(
+        max_batch=2, max_seq=64, chunk_steps=4, prefill_buckets=(32,),
+        eos_id=EOS, pipeline_depth=0,
+    )
+    eng = LMEngine(model, CFG, params, config=cfgobj).start()
+    try:
+        assert eng.pipeline_depth == 0
+        ids = [5, 9, 33, 60]
+        assert eng.submit(ids, max_new_tokens=6) == _reference_completion(
+            model, params, ids, 6
+        )
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        LMEngine(model, CFG, params, max_batch=2, pipeline_depth=2)
+    with pytest.raises(TypeError):
+        LMEngine(model, CFG, params, not_a_knob=1)
+
+
 def test_engine_with_sliding_window(model_and_params):
     """A sliding-window model served through the engine must produce the
     batch path's answers (which window via reference_attention) — exercises

@@ -385,3 +385,83 @@ def test_paged_engine_exports_pool_gauges():
         asyncio.run(run())
     finally:
         m.unload()
+
+
+# ----------------------------------------------- pipelined decode (carry)
+
+
+def test_paged_pipelined_parity_across_horizon_growth(model_and_params):
+    """Pipelined paged decode must stay byte-identical to the inline path
+    while the page read window grows ACROSS speculative chunks: a long
+    budget walks the pow2 page-window buckets (1 → 2 → 4 pages at
+    page_size=16) mid-generation, exercising the in-epoch table widening
+    without a full carry re-upload."""
+    model, params = model_and_params
+    kw = dict(
+        max_batch=2, max_seq=64, chunk_steps=4, prefill_buckets=(32,),
+        eos_id=EOS, kv_pool_tokens=16 * 12, page_size=16, seed=7,
+    )
+    rng = np.random.default_rng(61)
+    prompts = _prompts(rng, 3, lo=4, hi=11)
+    outs: dict[int, list[list[int]]] = {}
+    for depth in (0, 1):
+        eng = LMEngine(model, CFG, params, pipeline_depth=depth, **kw).start()
+        try:
+            outs[depth] = [
+                eng.submit(p, max_new_tokens=40) for p in prompts
+            ]
+            if depth == 1:
+                # widenings are log-bounded table uploads, never per-chunk
+                assert (
+                    eng.overlap["carry_uploads"] < eng.stats["chunks"]
+                ), (eng.overlap["carry_uploads"], eng.stats["chunks"])
+        finally:
+            eng.stop()
+    assert outs[0] == outs[1], (outs[0], outs[1])
+    assert any(len(o) > 0 for o in outs[1])
+
+
+def test_paged_pipelined_concurrent_with_backpressure(model_and_params):
+    """Pipelined paged mode under page backpressure (held admissions) and
+    concurrent mixed-length traffic: answers equal the inline engine's,
+    and the pool frees fully afterwards — a speculative chunk must never
+    leak pages of a retired row."""
+    model, params = model_and_params
+    kw = dict(
+        max_batch=3, max_seq=64, chunk_steps=4, prefill_buckets=(32,),
+        eos_id=EOS, kv_pool_tokens=16 * 7, page_size=16, seed=3,
+    )
+    rng = np.random.default_rng(67)
+    prompts = _prompts(rng, 6, lo=3, hi=14)
+
+    def run_mode(depth):
+        eng = LMEngine(model, CFG, params, pipeline_depth=depth, **kw).start()
+        outs: dict[int, list[int]] = {}
+        errors: list[Exception] = []
+
+        def worker(i):
+            try:
+                time.sleep(0.015 * i)
+                outs[i] = eng.submit(prompts[i], max_new_tokens=10)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            assert not errors, errors
+            assert eng.pager.used_pages == 0  # no leaked pages
+        finally:
+            eng.stop()
+        return outs
+
+    pipe = run_mode(1)
+    inline = run_mode(0)
+    for i in range(len(prompts)):
+        assert pipe[i] == inline[i], (i, pipe[i], inline[i])

@@ -197,6 +197,32 @@ def test_batcher_propagates_handler_errors():
     asyncio.run(run())
 
 
+def test_batcher_isolation_counts_instances_and_isolations():
+    """The isolate-offender path must still count succeeded instances
+    (regression: mean_occupancy silently undercounted after any co-batched
+    failure) and record the isolation event for /metrics."""
+
+    async def handler(flat):
+        if 13 in flat:  # the offender poisons the co-batched run too
+            raise ValueError("bad instance")
+        return flat
+
+    async def run():
+        b = Batcher(handler, BatcherConfig(max_batch_size=8, max_latency_ms=5))
+        t_ok = asyncio.create_task(b.submit([1, 2]))
+        t_bad = asyncio.create_task(b.submit([13]))
+        assert await asyncio.wait_for(t_ok, 2.0) == [1, 2]
+        with pytest.raises(ValueError, match="bad instance"):
+            await asyncio.wait_for(t_bad, 2.0)
+        # the survivor's 2 instances counted; the offender's never succeeded
+        assert b.stats["instances"] == 2
+        assert b.stats["fail_isolations"] == 1
+        assert b.stats["batches"] == 1  # one successful (isolated) call
+        assert b.mean_occupancy == 2.0
+
+    asyncio.run(run())
+
+
 # ------------------------------------------------------------------- server
 
 
@@ -294,6 +320,7 @@ def test_batcher_stats_exported_as_gauges():
     assert 'kubeflow_tpu_batcher_instances{model="dbl"} 3' in text
     assert 'kubeflow_tpu_batcher_batches{model="dbl"}' in text
     assert 'kubeflow_tpu_batcher_mean_occupancy{model="dbl"}' in text
+    assert 'kubeflow_tpu_batcher_fail_isolations{model="dbl"} 0' in text
     # shared registry: the collector refreshes values at scrape time
     from kubeflow_tpu.obs.prom import REGISTRY
 
