@@ -11,7 +11,9 @@ a plan against a job on a ``LocalCluster`` while measuring recovery
 
 from kubeflow_tpu.chaos.injectors import (  # noqa: F401
     corrupt_checkpoint,
+    kill_backend,
     record_injection,
+    resume_backend,
     storage_faults,
 )
 from kubeflow_tpu.chaos.plan import (  # noqa: F401

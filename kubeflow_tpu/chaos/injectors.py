@@ -94,6 +94,36 @@ def corrupt_checkpoint(
 
 
 # --------------------------------------------------------------------- #
+# serving backend faults (the gateway's production seam: real processes)
+# --------------------------------------------------------------------- #
+
+
+def kill_backend(pid: int, *, wedge: bool = False) -> None:
+    """Kill (SIGKILL) or wedge (SIGSTOP) a serving backend process
+    mid-request — the fault the gateway's retry + circuit-breaker path
+    must absorb invisibly for idempotent clients. ``wedge`` freezes the
+    process instead of killing it: connections stay open but nothing
+    answers, which exercises probe-driven outlier ejection rather than
+    the fast connection-refused path. Pair a wedge with
+    ``resume_backend`` to exercise half-open breaker recovery."""
+    import signal
+
+    os.kill(pid, signal.SIGSTOP if wedge else signal.SIGKILL)
+    record_injection("backend_wedge" if wedge else "backend_kill")
+    logger.warning(
+        "chaos: %s backend pid %d", "wedged" if wedge else "killed", pid
+    )
+
+
+def resume_backend(pid: int) -> None:
+    """SIGCONT a wedged backend — the recovery half of a wedge fault."""
+    import signal
+
+    os.kill(pid, signal.SIGCONT)
+    logger.warning("chaos: resumed backend pid %d", pid)
+
+
+# --------------------------------------------------------------------- #
 # storage / transfer faults
 # --------------------------------------------------------------------- #
 

@@ -23,6 +23,9 @@ Subcommands:
 - ``kft serve -f <path>`` — materialise an InferenceService manifest:
   storage-initialize the model, resolve its runtime from the default
   registry, serve REST (+ optional gRPC) until SIGINT.
+- ``kft gateway run -f <path>`` — run the L7 inference gateway from an
+  ``InferenceGateway`` manifest: health-probed backend pools, edge canary
+  split, activator buffering, per-tenant policy, /metrics.
 - ``kft models``       — model registry verbs (list/show/register/promote/
   rollback/lineage) over the store at ``--root``/``KFT_REGISTRY_ROOT``.
 - ``kft chaos run``    — run Job manifests under a declarative FaultPlan
@@ -251,7 +254,9 @@ def _cmd_serve(args) -> int:
             if spec.predictor.storage_uri
             else None
         )
-        model = rt.factory(spec.name, local)
+        # extra rides through to the runtime factory, matching the
+        # controller's _materialise_component contract
+        model = rt.factory(spec.name, local, **dict(spec.predictor.extra))
         server.register(model)
         print(f"inferenceservice/{spec.name}: loaded ({rt.name})")
     for g in graphs:  # after models: build validates every serviceName
@@ -280,6 +285,56 @@ def _cmd_serve(args) -> int:
                 await asyncio.sleep(3600)
         finally:
             await server.stop_async()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_gateway(args) -> int:
+    """Run the inference gateway from an ``InferenceGateway`` manifest —
+    the front door two (or two hundred) ``kft serve`` processes sit
+    behind. Prints the bound port (``--port-file`` for scripts), serves
+    until SIGINT."""
+    import asyncio
+
+    from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+
+    docs = [d for d in _load_docs(args.file) if d]
+    gw_docs = [d for d in docs if d.get("kind") == "InferenceGateway"]
+    if len(gw_docs) != 1:
+        print(
+            f"kft gateway: expected exactly one InferenceGateway manifest "
+            f"in {args.file}, found {len(gw_docs)}",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        config = GatewayConfig.from_manifest(gw_docs[0])
+    except (ValueError, KeyError, TypeError) as e:
+        print(f"kft gateway: invalid manifest: {e}", file=sys.stderr)
+        return 2
+    gw = InferenceGateway(config, http_port=args.http_port)
+    for r in gw.table.routes():
+        urls = [b.url for b in gw.pool.backends_of(r.name)]
+        print(
+            f"service/{r.name}: canary={r.canary_percent}% "
+            f"affinity={r.affinity} backends={urls}"
+        )
+
+    async def main() -> None:
+        await gw.start_async()
+        print(f"gateway on http://127.0.0.1:{gw.http_port}", flush=True)
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(str(gw.http_port))
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await gw.stop_async()
 
     try:
         asyncio.run(main())
@@ -843,6 +898,17 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--port-file", default=None,
                    help="write the bound HTTP port here once listening")
     s.set_defaults(fn=_cmd_serve)
+
+    gw = sub.add_parser(
+        "gateway", help="run the L7 inference gateway (Istio/Knative analog)"
+    )
+    gw.add_argument("action", choices=("run",))
+    gw.add_argument("-f", "--file", required=True,
+                    help="InferenceGateway manifest file")
+    gw.add_argument("--http-port", type=int, default=8081)
+    gw.add_argument("--port-file", default=None,
+                    help="write the bound HTTP port here once listening")
+    gw.set_defaults(fn=_cmd_gateway)
 
     pl = sub.add_parser(
         "pipeline", help="compile/upload/run pipelines (KFP-CLI analog)"

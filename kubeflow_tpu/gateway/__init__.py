@@ -1,0 +1,62 @@
+"""Inference gateway: the Istio-ingress/Knative-activator analog.
+
+An L7 front door over N real ``ModelServer`` replicas — backend pool with
+health probes + circuit breaking (``backends``), deterministic edge
+routing with canary split and LM prefix affinity (``router``),
+scale-from-zero request buffering (``activator``), per-tenant traffic
+policy (``policy``), and the aiohttp proxy tying them together
+(``server``). See README "Serving at the edge".
+"""
+
+from kubeflow_tpu.gateway.activator import (
+    ActivationTimeout,
+    Activator,
+    QueueOverflow,
+)
+from kubeflow_tpu.gateway.backends import (
+    Backend,
+    BackendPool,
+    BreakerConfig,
+    CircuitBreaker,
+)
+from kubeflow_tpu.gateway.policy import (
+    PolicyEngine,
+    RateLimited,
+    RetryBudget,
+    TenantPolicy,
+    TokenBucket,
+    TooManyInFlight,
+)
+from kubeflow_tpu.gateway.router import (
+    HashRing,
+    RouteTable,
+    ServiceRoute,
+    affinity_key_of,
+    canary_slot,
+    pick_revision,
+)
+from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+
+__all__ = [
+    "ActivationTimeout",
+    "Activator",
+    "Backend",
+    "BackendPool",
+    "BreakerConfig",
+    "CircuitBreaker",
+    "GatewayConfig",
+    "HashRing",
+    "InferenceGateway",
+    "PolicyEngine",
+    "QueueOverflow",
+    "RateLimited",
+    "RetryBudget",
+    "RouteTable",
+    "ServiceRoute",
+    "TenantPolicy",
+    "TokenBucket",
+    "TooManyInFlight",
+    "affinity_key_of",
+    "canary_slot",
+    "pick_revision",
+]

@@ -1,0 +1,120 @@
+"""Activator: park requests while a service has zero ready backends.
+
+Reference analog: the Knative activator (SURVEY.md §2.2) — when a service
+is scaled to zero, the activator sits in the data path, buffers requests,
+pokes the autoscaler, and replays the buffer once a pod is up. Here the
+same contract fronts real ``ModelServer`` processes:
+
+- a request arriving with no eligible backend parks in a **bounded FIFO**
+  per service (overflow → ``QueueOverflow`` ⇒ 429, deadline →
+  ``ActivationTimeout`` ⇒ 503 — the two Knative envelope semantics);
+- parking kicks ``scale_up(service)`` once per cold episode (not per
+  request), which is where a controller loads the model / starts a
+  replica **off the request path** — the synchronous cold-start load that
+  used to live inside ``controller.route()`` happens here, concurrently
+  with the client waiting;
+- when the pool reports a backend ready, the queue flushes strictly in
+  admission order (the event loop wakes futures FIFO).
+
+Event-loop confined: no threads, no locks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import OrderedDict, deque
+from typing import Callable
+
+from kubeflow_tpu.obs import names, prom
+
+QUEUE_DEPTH = prom.REGISTRY.gauge(
+    names.GATEWAY_QUEUE_DEPTH,
+    "requests parked in the activator FIFO",
+    ("service",),
+)
+ACTIVATIONS = prom.REGISTRY.counter(
+    names.GATEWAY_ACTIVATIONS_TOTAL,
+    "scale-from-zero kicks issued by the activator",
+    ("service",),
+)
+
+
+class QueueOverflow(Exception):
+    """Parked-queue capacity exceeded — shed with 429."""
+
+
+class ActivationTimeout(Exception):
+    """No backend became ready within the deadline — shed with 503."""
+
+
+class Activator:
+    def __init__(
+        self,
+        *,
+        queue_limit: int = 256,
+        timeout_s: float = 30.0,
+        scale_up: Callable[[str], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.queue_limit = queue_limit
+        self.timeout_s = timeout_s
+        self.scale_up = scale_up
+        self._clock = clock
+        self._parked: dict[str, deque[asyncio.Future]] = {}
+        #: services with a scale-up kick outstanding; cleared on flush so
+        #: the next cold episode kicks again. Ordered for stable views.
+        self._kicked: OrderedDict[str, float] = OrderedDict()
+
+    def depth(self, service: str) -> int:
+        return len(self._parked.get(service, ()))
+
+    async def wait(self, service: str, *, timeout_s: float | None = None) -> None:
+        """Park until ``notify(service)`` — admission order preserved."""
+        q = self._parked.setdefault(service, deque())
+        if len(q) >= self.queue_limit:
+            raise QueueOverflow(
+                f"activator queue for {service!r} is full "
+                f"({self.queue_limit} parked)"
+            )
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        q.append(fut)
+        QUEUE_DEPTH.labels(service=service).set(len(q))
+        if service not in self._kicked and self.scale_up is not None:
+            self._kicked[service] = self._clock()
+            ACTIVATIONS.labels(service=service).inc()
+            try:
+                self.scale_up(service)
+            except Exception:  # noqa: BLE001 — a failed kick must not kill
+                pass  # the parked request; the deadline still bounds it
+        try:
+            await asyncio.wait_for(
+                fut, self.timeout_s if timeout_s is None else timeout_s
+            )
+        except asyncio.TimeoutError:
+            raise ActivationTimeout(
+                f"no backend for {service!r} became ready in time"
+            ) from None
+        finally:
+            if fut in q:
+                q.remove(fut)
+            QUEUE_DEPTH.labels(service=service).set(len(q))
+
+    def notify(self, service: str) -> None:
+        """A backend for ``service`` is ready: wake every parked waiter in
+        admission (FIFO) order. Waiters re-select a backend themselves —
+        the first may consume capacity, later ones may re-park."""
+        self._kicked.pop(service, None)
+        q = self._parked.get(service)
+        if not q:
+            return
+        # snapshot: waking a future triggers its finally-removal from q
+        for fut in list(q):
+            if not fut.done():
+                fut.set_result(True)
+
+    def view(self) -> dict:
+        return {
+            "queue_depth": {s: len(q) for s, q in self._parked.items() if q},
+            "pending_scale_ups": list(self._kicked),
+        }

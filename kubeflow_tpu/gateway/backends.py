@@ -1,0 +1,356 @@
+"""Backend pool: the gateway's view of real server replicas.
+
+Reference analogs: Envoy's cluster model as Istio deploys it (endpoint
+health checking, outlier detection, circuit breaking — SURVEY.md §2.2) and
+Knative's revision-backed endpoints. A ``Backend`` is one live
+``ModelServer`` process addressed by URL; the pool owns everything about
+its fitness to receive traffic:
+
+- **readiness probing** — ``GET /v2/health/ready`` on an interval; a
+  backend that fails ``eject_threshold`` consecutive probes is ejected
+  (outlier detection) and re-admitted on the first passing probe;
+- **circuit breaking** — request outcomes drive a per-backend breaker:
+  ``failure_threshold`` consecutive failures open it, after ``recovery_s``
+  it goes half-open and admits ONE trial request; success closes it,
+  failure re-opens. Open/half-open state is visible on /metrics so a
+  flapping replica is diagnosable from the edge;
+- **drain-aware removal** — ``drain()`` stops new selection immediately
+  and removes the backend once its last in-flight request releases, so
+  rolling restarts are lossless;
+- **least-outstanding selection** — the balancer picks the eligible
+  backend with the fewest in-flight requests (round-robin among ties, a
+  counter rather than RNG so routing stays deterministic and seedless).
+
+Everything here runs on the gateway's event loop — no threads, no locks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+from kubeflow_tpu.obs import names, prom
+
+BREAKER_OPEN = prom.REGISTRY.gauge(
+    names.GATEWAY_BREAKER_OPEN,
+    "1 while this backend's circuit breaker is open or half-open",
+    ("backend",),
+)
+BREAKER_OPENS = prom.REGISTRY.counter(
+    names.GATEWAY_BREAKER_OPENS_TOTAL,
+    "closed-to-open breaker transitions",
+    ("backend",),
+)
+BACKENDS_READY = prom.REGISTRY.gauge(
+    names.GATEWAY_BACKENDS_READY,
+    "backends currently eligible for selection",
+    ("service",),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BreakerConfig:
+    failure_threshold: int = 3
+    recovery_s: float = 5.0
+
+
+class CircuitBreaker:
+    """Per-backend request-outcome state machine (closed → open → half-open).
+
+    ``clock`` is injectable so tests drive recovery without sleeping.
+    """
+
+    def __init__(
+        self,
+        config: BreakerConfig | None = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or BreakerConfig()
+        self._clock = clock
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self._opened_at = 0.0
+        self._trial_in_flight = False
+
+    def current_state(self) -> str:
+        """State after applying the open→half-open time transition."""
+        if (
+            self.state == "open"
+            and self._clock() - self._opened_at >= self.config.recovery_s
+        ):
+            self.state = "half_open"
+            self._trial_in_flight = False
+        return self.state
+
+    def allow(self) -> bool:
+        """May a request be dispatched now? Half-open grants exactly one
+        trial at a time; the trial's outcome decides the next state."""
+        st = self.current_state()
+        if st == "closed":
+            return True
+        if st == "half_open" and not self._trial_in_flight:
+            self._trial_in_flight = True
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self._trial_in_flight = False
+        self.state = "closed"
+
+    def record_failure(self) -> bool:
+        """Returns True when this failure TRANSITIONS the breaker to open
+        (callers count distinct opens, not every failed request)."""
+        self.consecutive_failures += 1
+        st = self.current_state()
+        if st == "half_open":
+            self.state = "open"
+            self._opened_at = self._clock()
+            self._trial_in_flight = False
+            return False  # re-open of an already-tripped breaker
+        if st == "closed" and (
+            self.consecutive_failures >= self.config.failure_threshold
+        ):
+            self.state = "open"
+            self._opened_at = self._clock()
+            return True
+        return False
+
+
+@dataclasses.dataclass
+class Backend:
+    """One addressable server replica behind the gateway."""
+
+    url: str
+    service: str
+    revision: str = "default"  # "default" | "canary"
+    state: str = "active"  # "active" | "draining"
+    outstanding: int = 0
+    probe_ok: bool = True  # optimistic until the first probe says otherwise
+    consecutive_probe_failures: int = 0
+    breaker: CircuitBreaker = dataclasses.field(default_factory=CircuitBreaker)
+
+    def view(self) -> dict:
+        return {
+            "url": self.url,
+            "service": self.service,
+            "revision": self.revision,
+            "state": self.state,
+            "outstanding": self.outstanding,
+            "probe_ok": self.probe_ok,
+            "breaker": self.breaker.current_state(),
+        }
+
+
+class BackendPool:
+    """All backends the gateway may route to, keyed by service."""
+
+    def __init__(
+        self,
+        *,
+        breaker: BreakerConfig | None = None,
+        probe_interval_s: float = 1.0,
+        probe_timeout_s: float = 2.0,
+        eject_threshold: int = 3,
+        on_ready: Callable[[str], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._breaker_cfg = breaker or BreakerConfig()
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.eject_threshold = eject_threshold
+        #: called with the service name whenever a backend becomes eligible
+        #: again (probe recovery, breaker close, new backend) — the
+        #: activator flushes its parked queue off this signal
+        self.on_ready = on_ready
+        self._clock = clock
+        self._backends: dict[str, list[Backend]] = {}
+        self._rr: dict[str, int] = {}  # tie-break rotation per service
+
+    # -- membership ------------------------------------------------------ #
+
+    def add(
+        self, service: str, url: str, *, revision: str = "default"
+    ) -> Backend:
+        existing = self.find(url)
+        if existing is not None:
+            # re-add of a draining/known URL revives it in place
+            existing.state = "active"
+            existing.service = service
+            existing.revision = revision
+            self._notify_ready(service)
+            return existing
+        b = Backend(
+            url=url.rstrip("/"),
+            service=service,
+            revision=revision,
+            breaker=CircuitBreaker(self._breaker_cfg, clock=self._clock),
+        )
+        self._backends.setdefault(service, []).append(b)
+        self._refresh_ready_gauge(service)
+        self._notify_ready(service)
+        return b
+
+    def find(self, url: str) -> Backend | None:
+        url = url.rstrip("/")
+        for blist in self._backends.values():
+            for b in blist:
+                if b.url == url:
+                    return b
+        return None
+
+    def drain(self, url: str) -> None:
+        """Stop selecting the backend; it is removed once its in-flight
+        count hits zero (lossless rolling-restart removal)."""
+        b = self.find(url)
+        if b is None:
+            return
+        b.state = "draining"
+        if b.outstanding == 0:
+            self._remove(b)
+        self._refresh_ready_gauge(b.service)
+
+    def remove(self, url: str) -> None:
+        b = self.find(url)
+        if b is not None:
+            self._remove(b)
+
+    def _remove(self, b: Backend) -> None:
+        blist = self._backends.get(b.service, [])
+        if b in blist:
+            blist.remove(b)
+        self._refresh_ready_gauge(b.service)
+
+    def services(self) -> list[str]:
+        return sorted(self._backends)
+
+    def backends_of(self, service: str) -> list[Backend]:
+        return list(self._backends.get(service, []))
+
+    # -- selection ------------------------------------------------------- #
+
+    def selectable(self, service: str, revision: str | None = None) -> list[Backend]:
+        """Backends eligible for new traffic (active, probe-healthy; the
+        breaker filter happens in ``pick`` so half-open trials stay single)."""
+        return [
+            b
+            for b in self._backends.get(service, [])
+            if b.state == "active"
+            and b.probe_ok
+            and (revision is None or b.revision == revision)
+        ]
+
+    def pick(
+        self, service: str, revision: str | None = None
+    ) -> Backend | None:
+        """Least-outstanding-requests among breaker-closed backends;
+        falls back to granting one half-open trial when nothing is closed."""
+        base = self.selectable(service, revision)
+        closed = [b for b in base if b.breaker.current_state() == "closed"]
+        if closed:
+            low = min(b.outstanding for b in closed)
+            tied = [b for b in closed if b.outstanding == low]
+            i = self._rr.get(service, 0)
+            self._rr[service] = i + 1
+            return tied[i % len(tied)]
+        # every healthy backend is tripped: probe the least-loaded one
+        # whose breaker grants a trial (half-open single-request semantics)
+        for b in sorted(base, key=lambda b: (b.outstanding, b.url)):
+            if b.breaker.allow():
+                return b
+        return None
+
+    def acquire(self, b: Backend) -> None:
+        b.outstanding += 1
+
+    def release(self, b: Backend) -> None:
+        b.outstanding -= 1
+        if b.state == "draining" and b.outstanding <= 0:
+            self._remove(b)
+
+    # -- request outcomes ------------------------------------------------ #
+
+    def record(self, b: Backend, ok: bool) -> None:
+        if ok:
+            was_open = b.breaker.state != "closed"
+            b.breaker.record_success()
+            BREAKER_OPEN.labels(backend=b.url).set(0)
+            if was_open:
+                self._notify_ready(b.service)
+        else:
+            if b.breaker.record_failure():
+                BREAKER_OPENS.labels(backend=b.url).inc()
+            BREAKER_OPEN.labels(backend=b.url).set(
+                0 if b.breaker.state == "closed" else 1
+            )
+        self._refresh_ready_gauge(b.service)
+
+    # -- probing --------------------------------------------------------- #
+
+    async def probe_all(self, session) -> None:
+        """One probe sweep over every backend (the gateway's probe task
+        calls this on ``probe_interval_s``). ``session`` is an aiohttp
+        ClientSession owned by the caller."""
+        import asyncio
+
+        import aiohttp
+
+        async def probe(b: Backend) -> None:
+            ok = False
+            try:
+                async with session.get(
+                    f"{b.url}/v2/health/ready",
+                    timeout=aiohttp.ClientTimeout(total=self.probe_timeout_s),
+                ) as resp:
+                    ok = resp.status == 200 and bool(
+                        (await resp.json()).get("ready", False)
+                    )
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                ok = False
+            self.observe_probe(b, ok)
+
+        backends = [b for bl in self._backends.values() for b in bl]
+        if backends:
+            await asyncio.gather(*[probe(b) for b in backends])
+
+    def observe_probe(self, b: Backend, ok: bool) -> None:
+        """Fold one probe result into ejection state (also the unit-test
+        seam — tests drive ejection without HTTP)."""
+        if ok:
+            b.consecutive_probe_failures = 0
+            if not b.probe_ok:
+                b.probe_ok = True
+                self._notify_ready(b.service)
+        else:
+            b.consecutive_probe_failures += 1
+            if b.consecutive_probe_failures >= self.eject_threshold:
+                b.probe_ok = False  # outlier ejected until a probe passes
+        self._refresh_ready_gauge(b.service)
+
+    # -- plumbing -------------------------------------------------------- #
+
+    def ready_count(self, service: str) -> int:
+        return len(
+            [
+                b
+                for b in self.selectable(service)
+                if b.breaker.current_state() != "open"
+            ]
+        )
+
+    def _refresh_ready_gauge(self, service: str) -> None:
+        BACKENDS_READY.labels(service=service).set(self.ready_count(service))
+
+    def _notify_ready(self, service: str) -> None:
+        self._refresh_ready_gauge(service)
+        if self.on_ready is not None and self.ready_count(service) > 0:
+            self.on_ready(service)
+
+    def view(self) -> list[dict]:
+        return [
+            b.view()
+            for svc in sorted(self._backends)
+            for b in self._backends[svc]
+        ]

@@ -1,0 +1,694 @@
+"""The L7 inference gateway: one front door over N ModelServer replicas.
+
+Reference analog: the half of KServe's request path we had not built —
+Istio ingress + the Knative activator (SURVEY.md §2.2). Every request
+flows:
+
+    client → policy (tenant rate/in-flight) → route table (host/path)
+           → revision split (salted hash, edge-decided)
+           → activator (park if scaled to zero)
+           → backend pick (prefix affinity | least-outstanding)
+           → proxy (retries within budget, optional hedging, SSE passthrough)
+
+Design commitments, each load-bearing:
+
+- **deterministic routing** — the canary decision hashes the request id
+  (``router.canary_slot``), so retries never flap revisions; balancing
+  ties rotate a counter; NOTHING in the request path draws randomness;
+- **cold start off the request path** — zero ready backends parks the
+  request in the activator's bounded FIFO and kicks ``scale_up`` once;
+  the model load happens concurrently with the client waiting, not
+  inside it;
+- **failures are the gateway's job** — connect errors and 502/503/504
+  feed the backend's breaker and are retried transparently (idempotent
+  verbs only, within the retry budget); an SSE stream that dies
+  mid-flight surfaces a clean terminal error frame instead of a torn
+  socket; a client that disconnects mid-stream tears down the upstream
+  connection so the backend cancels the engine row;
+- **observable** — every decision increments a ``kft_gateway_*`` metric
+  (obs/names.py), served at ``GET /metrics`` in Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+import uuid
+from typing import Any, Callable
+
+from kubeflow_tpu.obs import names, prom
+from kubeflow_tpu.gateway.activator import (
+    ActivationTimeout,
+    Activator,
+    QueueOverflow,
+)
+from kubeflow_tpu.gateway.backends import Backend, BackendPool, BreakerConfig
+from kubeflow_tpu.gateway.policy import (
+    PolicyEngine,
+    RateLimited,
+    RetryBudget,
+    TenantPolicy,
+    TokenBucket,
+    TooManyInFlight,
+)
+from kubeflow_tpu.gateway.router import (
+    HashRing,
+    RouteTable,
+    ServiceRoute,
+    affinity_key_of,
+)
+
+REQUESTS = prom.REGISTRY.counter(
+    names.GATEWAY_REQUESTS_TOTAL,
+    "requests answered at the edge, by status",
+    ("service", "code"),
+)
+LATENCY = prom.REGISTRY.histogram(
+    names.GATEWAY_LATENCY_SECONDS,
+    "edge-observed request latency (activator queue time included)",
+    ("service",),
+)
+SHED = prom.REGISTRY.counter(
+    names.GATEWAY_SHED_TOTAL,
+    "requests shed at the edge",
+    ("service", "reason"),
+)
+RETRIES = prom.REGISTRY.counter(
+    names.GATEWAY_RETRIES_TOTAL,
+    "transparent re-dispatches after a backend failure",
+    ("service",),
+)
+HEDGES = prom.REGISTRY.counter(
+    names.GATEWAY_HEDGES_TOTAL,
+    "hedged second requests dispatched",
+    ("service",),
+)
+AFFINITY_ROUTED = prom.REGISTRY.counter(
+    names.GATEWAY_AFFINITY_ROUTED_TOTAL,
+    "requests routed by prefix/session affinity",
+    ("service",),
+)
+
+#: hop-by-hop headers never forwarded either direction
+_HOP_HEADERS = {
+    "host", "content-length", "transfer-encoding", "connection",
+    "keep-alive", "upgrade", "proxy-authorization", "proxy-connection",
+}
+
+#: verbs safe to retry/hedge: reads, and the stateless inference verbs
+_IDEMPOTENT_SUFFIXES = (":predict", "/infer")
+
+#: upstream statuses that indicate backend (not request) trouble
+_BACKEND_FAILURE_STATUSES = (502, 503, 504)
+
+
+class _UpstreamError(Exception):
+    def __init__(self, backend: Backend, cause: BaseException):
+        super().__init__(f"{backend.url}: {cause}")
+        self.backend = backend
+        self.cause = cause
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    name: str = "gateway"
+    salt: str = "kft-canary"
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    failure_threshold: int = 3
+    recovery_s: float = 5.0
+    eject_threshold: int = 3
+    queue_limit: int = 256
+    activation_timeout_s: float = 30.0
+    upstream_timeout_s: float = 120.0
+    connect_timeout_s: float = 5.0
+    retry_budget_ratio: float = 0.2
+    retry_budget_floor: int = 3
+    routes: list[ServiceRoute] = dataclasses.field(default_factory=list)
+    #: (service, url, revision) triples registered at startup
+    backends: list[tuple[str, str, str]] = dataclasses.field(
+        default_factory=list
+    )
+    #: tenant → {max_rps, burst, max_in_flight}
+    tenants: dict[str, dict] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_manifest(cls, doc: dict) -> "GatewayConfig":
+        """``kind: InferenceGateway`` manifest → config (KServe-style
+        camelCase spec keys)."""
+        if doc.get("kind", "InferenceGateway") != "InferenceGateway":
+            raise ValueError(
+                f"not an InferenceGateway manifest: {doc.get('kind')!r}"
+            )
+        spec = doc.get("spec", {})
+        cfg = cls(name=doc.get("metadata", {}).get("name", "gateway"))
+        for yaml_key, attr in (
+            ("salt", "salt"),
+            ("probeIntervalS", "probe_interval_s"),
+            ("probeTimeoutS", "probe_timeout_s"),
+            ("failureThreshold", "failure_threshold"),
+            ("recoveryS", "recovery_s"),
+            ("ejectThreshold", "eject_threshold"),
+            ("queueLimit", "queue_limit"),
+            ("activationTimeoutS", "activation_timeout_s"),
+            ("upstreamTimeoutS", "upstream_timeout_s"),
+            ("connectTimeoutS", "connect_timeout_s"),
+            ("retryBudgetRatio", "retry_budget_ratio"),
+            ("retryBudgetFloor", "retry_budget_floor"),
+        ):
+            if yaml_key in spec:
+                setattr(cfg, attr, type(getattr(cfg, attr))(spec[yaml_key]))
+        for svc in spec.get("services", []):
+            name = svc["name"]
+            cfg.routes.append(
+                ServiceRoute(
+                    name=name,
+                    hosts=tuple(svc.get("hosts", ())),
+                    path_prefixes=tuple(svc.get("pathPrefixes", ())),
+                    canary_percent=float(svc.get("canaryPercent", 0)),
+                    affinity=svc.get("affinity", "none"),
+                    affinity_prefix_tokens=int(
+                        svc.get("affinityPrefixTokens", 16)
+                    ),
+                    hedge_ms=(
+                        float(svc["hedgeMs"]) if "hedgeMs" in svc else None
+                    ),
+                    max_attempts=int(svc.get("maxAttempts", 3)),
+                )
+            )
+            for be in svc.get("backends", []):
+                if isinstance(be, str):
+                    cfg.backends.append((name, be, "default"))
+                else:
+                    cfg.backends.append(
+                        (name, be["url"], be.get("revision", "default"))
+                    )
+        for tenant, pol in (spec.get("policy", {}).get("tenants", {})).items():
+            cfg.tenants[tenant] = {
+                "max_rps": pol.get("maxRps"),
+                "burst": pol.get("burst"),
+                "max_in_flight": pol.get("maxInFlight"),
+            }
+        return cfg
+
+
+class InferenceGateway:
+    def __init__(
+        self,
+        config: GatewayConfig | None = None,
+        *,
+        http_port: int = 0,
+        controller: Any = None,
+        scale_up: Callable[[str], None] | None = None,
+        policy: PolicyEngine | None = None,
+    ):
+        self.config = config or GatewayConfig()
+        self.http_port = http_port
+        #: optional InferenceServiceController whose state feeds the route
+        #: table (resynced every probe tick)
+        self.controller = controller
+        self.activator = Activator(
+            queue_limit=self.config.queue_limit,
+            timeout_s=self.config.activation_timeout_s,
+            scale_up=scale_up,
+        )
+        self.pool = BackendPool(
+            breaker=BreakerConfig(
+                failure_threshold=self.config.failure_threshold,
+                recovery_s=self.config.recovery_s,
+            ),
+            probe_interval_s=self.config.probe_interval_s,
+            probe_timeout_s=self.config.probe_timeout_s,
+            eject_threshold=self.config.eject_threshold,
+            on_ready=self.activator.notify,
+        )
+        self.table = RouteTable(salt=self.config.salt)
+        for r in self.config.routes:
+            self.table.upsert(r)
+        for service, url, revision in self.config.backends:
+            if self.table.get(service) is None:
+                self.table.upsert(ServiceRoute(name=service))
+            self.pool.add(service, url, revision=revision)
+        if policy is not None:
+            self.policy = policy
+        else:
+            self.policy = PolicyEngine()
+            for tenant, p in self.config.tenants.items():
+                self.policy.set(
+                    tenant,
+                    TenantPolicy(
+                        bucket=(
+                            TokenBucket(p["max_rps"], p.get("burst"))
+                            if p.get("max_rps") is not None
+                            else None
+                        ),
+                        max_in_flight=p.get("max_in_flight"),
+                    ),
+                )
+        self._budgets: dict[str, RetryBudget] = {}
+        self._rings: dict[tuple[str, ...], HashRing] = {}
+        self._session = None
+        self._probe_task: asyncio.Task | None = None
+        self._runner = None
+        if self.controller is not None:
+            self.table.update_from_controller(self.controller)
+
+    # -- app ------------------------------------------------------------- #
+
+    def build_app(self):
+        from aiohttp import web
+
+        app = web.Application(client_max_size=64 * 2**20)
+        app.router.add_get("/gateway/healthz", self._healthz)
+        app.router.add_get("/gateway/state", self._state)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_route("*", "/{tail:.*}", self._proxy)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        import aiohttp
+
+        self._session = aiohttp.ClientSession()
+        self._probe_task = asyncio.create_task(self._probe_loop())
+
+    async def _on_cleanup(self, app) -> None:
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except asyncio.CancelledError:
+                pass
+            self._probe_task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.probe_interval_s)
+            if self.controller is not None:
+                self.table.update_from_controller(self.controller)
+            await self.pool.probe_all(self._session)
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.json_response({"ok": True, "name": self.config.name})
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        return web.Response(text=prom.REGISTRY.expose())
+
+    async def _state(self, request):
+        from aiohttp import web
+
+        return web.json_response(self.state_view())
+
+    def state_view(self) -> dict:
+        routes = self.table.routes()
+        return {
+            "name": self.config.name,
+            "services": [
+                {
+                    **r.view(),
+                    "ready_backends": self.pool.ready_count(r.name),
+                    "queue_depth": self.activator.depth(r.name),
+                    "backends": [
+                        b.view() for b in self.pool.backends_of(r.name)
+                    ],
+                }
+                for r in routes
+            ],
+            "policy": self.policy.view(),
+            "activator": self.activator.view(),
+        }
+
+    # -- the request path ------------------------------------------------ #
+
+    async def _proxy(self, request):
+        from aiohttp import web
+
+        t0 = time.perf_counter()
+        resolved = self.table.resolve(
+            request.headers.get("host"), request.path
+        )
+        if resolved is None:
+            REQUESTS.labels(service="_unmatched", code="404").inc()
+            raise web.HTTPNotFound(
+                reason=f"no service routes {request.path!r}"
+            )
+        route, path = resolved
+        service = route.name
+        tenant = request.headers.get("x-kft-tenant", "default")
+        try:
+            self.policy.acquire(tenant)
+        except RateLimited as e:
+            SHED.labels(service=service, reason="rate_limit").inc()
+            REQUESTS.labels(service=service, code="429").inc()
+            raise web.HTTPTooManyRequests(
+                reason=str(e), headers={"Retry-After": "1"}
+            )
+        except TooManyInFlight as e:
+            SHED.labels(service=service, reason="inflight_cap").inc()
+            REQUESTS.labels(service=service, code="429").inc()
+            raise web.HTTPTooManyRequests(reason=str(e))
+        try:
+            resp = await self._routed(request, route, path)
+            REQUESTS.labels(service=service, code=str(resp.status)).inc()
+            return resp
+        except web.HTTPException as e:
+            REQUESTS.labels(service=service, code=str(e.status)).inc()
+            raise
+        finally:
+            self.policy.release(tenant)
+            LATENCY.labels(service=service).observe(time.perf_counter() - t0)
+
+    async def _routed(self, request, route: ServiceRoute, path: str):
+        from aiohttp import web
+
+        req_id = request.headers.get("x-request-id") or uuid.uuid4().hex
+        body = await request.read() if request.can_read_body else b""
+        revision = self.table.revision_for(route, req_id)
+        affinity_key = None
+        if route.affinity != "none":
+            try:
+                parsed = json.loads(body) if body else None
+            except ValueError:
+                parsed = None
+            affinity_key = affinity_key_of(route, request.headers, parsed)
+        fwd = {
+            k: v
+            for k, v in request.headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        fwd["x-request-id"] = req_id
+        is_stream = path.endswith("/generate_stream")
+        idempotent = request.method == "GET" or any(
+            path.endswith(s) for s in _IDEMPOTENT_SUFFIXES
+        )
+        budget = self._budgets.setdefault(route.name, RetryBudget(
+            ratio=self.config.retry_budget_ratio,
+            floor=self.config.retry_budget_floor,
+        ))
+        budget.on_request()
+
+        parks = 0
+        attempts = 0
+        last_err: _UpstreamError | None = None
+        while True:
+            backend = self._select(route, revision, affinity_key)
+            if backend is None:
+                parks += 1
+                if parks > 8:
+                    break  # repeated wake-ups without capacity: shed below
+                try:
+                    await self.activator.wait(route.name)
+                except QueueOverflow as e:
+                    SHED.labels(
+                        service=route.name, reason="queue_full"
+                    ).inc()
+                    raise web.HTTPTooManyRequests(reason=str(e))
+                except ActivationTimeout as e:
+                    SHED.labels(
+                        service=route.name, reason="activation_timeout"
+                    ).inc()
+                    raise web.HTTPServiceUnavailable(reason=str(e))
+                continue
+            try:
+                if is_stream:
+                    # connect-level stream failures retry like any other
+                    # attempt (no response bytes have committed yet);
+                    # mid-stream failures are terminal inside _proxy_stream
+                    return await self._proxy_stream(
+                        request, route, backend, path, fwd, body
+                    )
+                return await self._attempt(
+                    route, backend, request.method, path, fwd, body,
+                    idempotent=idempotent,
+                )
+            except _UpstreamError as e:
+                last_err = e
+                attempts += 1
+                # streams only raise here on CONNECT failure (nothing has
+                # committed to the client), so they are safe to re-dispatch
+                if (
+                    (idempotent or is_stream)
+                    and attempts < route.max_attempts
+                    and budget.try_spend()
+                ):
+                    RETRIES.labels(service=route.name).inc()
+                    continue
+                break
+        SHED.labels(service=route.name, reason="no_backend").inc()
+        raise web.HTTPServiceUnavailable(
+            reason=(
+                f"no backend could serve {route.name!r}"
+                + (f" (last error: {last_err})" if last_err else "")
+            )
+        )
+
+    def _select(
+        self, route: ServiceRoute, revision: str, affinity_key: str | None
+    ) -> Backend | None:
+        """Affinity first (closed-breaker replicas only), then
+        least-outstanding; a canary decision with no live canary backends
+        falls back to the default revision rather than shedding."""
+        rev = revision
+        if rev == "canary" and not self.pool.selectable(route.name, "canary"):
+            rev = "default"
+        if affinity_key is not None:
+            b = self._affine_pick(route, rev, affinity_key)
+            if b is not None:
+                AFFINITY_ROUTED.labels(service=route.name).inc()
+                return b
+        b = self.pool.pick(route.name, rev)
+        if b is None:
+            b = self.pool.pick(route.name, None)
+        return b
+
+    def _affine_pick(
+        self, route: ServiceRoute, revision: str, key: str
+    ) -> Backend | None:
+        cands = [
+            b
+            for b in self.pool.selectable(route.name, revision)
+            if b.breaker.current_state() == "closed"
+        ]
+        if not cands:
+            return None
+        urls = tuple(sorted(b.url for b in cands))
+        ring = self._rings.get(urls)
+        if ring is None:
+            if len(self._rings) > 128:  # membership churn: drop stale rings
+                self._rings.clear()
+            ring = self._rings[urls] = HashRing(urls)
+        url = ring.pick(key)
+        b = next(b for b in cands if b.url == url)
+        if (
+            route.affinity_max_outstanding is not None
+            and b.outstanding >= route.affinity_max_outstanding
+        ):
+            return None  # affine replica saturated: spill to the balancer
+        return b
+
+    # -- one upstream attempt (with optional hedging) -------------------- #
+
+    async def _attempt(
+        self,
+        route: ServiceRoute,
+        backend: Backend,
+        method: str,
+        path: str,
+        fwd: dict,
+        body: bytes,
+        *,
+        idempotent: bool,
+    ):
+        if (
+            route.hedge_ms is not None
+            and idempotent
+            and len(self.pool.selectable(route.name)) > 1
+        ):
+            return await self._hedged(route, backend, method, path, fwd, body)
+        return await self._attempt_once(route, backend, method, path, fwd, body)
+
+    async def _hedged(self, route, primary, method, path, fwd, body):
+        """Race a second attempt dispatched ``hedge_ms`` after the first;
+        first success wins, the loser is cancelled."""
+        first = asyncio.ensure_future(
+            self._attempt_once(route, primary, method, path, fwd, body)
+        )
+        done, _ = await asyncio.wait(
+            {first}, timeout=route.hedge_ms / 1e3
+        )
+        if done:
+            return first.result()  # raises _UpstreamError if it failed fast
+        second_backend = self.pool.pick(route.name)
+        if second_backend is None or second_backend is primary:
+            return await first
+        HEDGES.labels(service=route.name).inc()
+        second = asyncio.ensure_future(
+            self._attempt_once(
+                route, second_backend, method, path, fwd, body
+            )
+        )
+        pending = {first, second}
+        result = None
+        err: _UpstreamError | None = None
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                try:
+                    result = t.result()
+                except _UpstreamError as e:
+                    err = e
+            if result is not None:
+                for t in pending:
+                    t.cancel()
+                return result
+        assert err is not None
+        raise err
+
+    async def _attempt_once(
+        self, route, backend: Backend, method, path, fwd, body
+    ):
+        import aiohttp
+        from aiohttp import web
+
+        self.pool.acquire(backend)
+        try:
+            async with self._session.request(
+                method,
+                backend.url + path,
+                data=body if method not in ("GET", "HEAD") else None,
+                headers=fwd,
+                timeout=aiohttp.ClientTimeout(
+                    total=self.config.upstream_timeout_s,
+                    sock_connect=self.config.connect_timeout_s,
+                ),
+            ) as upstream:
+                payload = await upstream.read()
+                status = upstream.status
+                ctype = upstream.headers.get(
+                    "Content-Type", "application/json"
+                )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            self.pool.record(backend, ok=False)
+            raise _UpstreamError(backend, e) from e
+        finally:
+            self.pool.release(backend)
+        if status in _BACKEND_FAILURE_STATUSES:
+            self.pool.record(backend, ok=False)
+            raise _UpstreamError(
+                backend, RuntimeError(f"upstream returned {status}")
+            )
+        self.pool.record(backend, ok=True)
+        return web.Response(
+            body=payload, status=status, headers={"Content-Type": ctype}
+        )
+
+    # -- SSE passthrough ------------------------------------------------- #
+
+    async def _proxy_stream(
+        self, request, route: ServiceRoute, backend: Backend, path, fwd, body
+    ):
+        """Stream upstream SSE bytes to the client verbatim. A backend
+        that dies mid-stream yields one clean terminal error frame; a
+        client that disconnects tears down the upstream connection, which
+        the ModelServer observes and cancels the engine row."""
+        import aiohttp
+        from aiohttp import web
+
+        self.pool.acquire(backend)
+        upstream = None
+        try:
+            try:
+                upstream = await self._session.post(
+                    backend.url + path,
+                    data=body,
+                    headers=fwd,
+                    timeout=aiohttp.ClientTimeout(
+                        total=None,
+                        sock_connect=self.config.connect_timeout_s,
+                    ),
+                )
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                self.pool.record(backend, ok=False)
+                raise _UpstreamError(backend, e) from e
+            if upstream.status != 200:
+                # pre-stream refusal (429 overload, 400, 501): pass through
+                payload = await upstream.read()
+                if upstream.status in _BACKEND_FAILURE_STATUSES:
+                    self.pool.record(backend, ok=False)
+                else:
+                    self.pool.record(backend, ok=True)
+                return web.Response(
+                    body=payload,
+                    status=upstream.status,
+                    headers={
+                        "Content-Type": upstream.headers.get(
+                            "Content-Type", "application/json"
+                        )
+                    },
+                )
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                }
+            )
+            await resp.prepare(request)
+            try:
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                self.pool.record(backend, ok=True)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                # backend died mid-stream: a clean terminal frame, not a
+                # torn socket — the client's SSE parser sees one error event
+                self.pool.record(backend, ok=False)
+                frame = json.dumps(
+                    {"error": f"upstream failed mid-stream: {e}"}
+                )
+                await resp.write(f"data: {frame}\n\n".encode())
+            await resp.write_eof()
+            return resp
+        finally:
+            if upstream is not None:
+                upstream.close()  # hard close → backend sees the disconnect
+            self.pool.release(backend)
+
+    # -- runtime --------------------------------------------------------- #
+
+    async def start_async(self) -> None:
+        from aiohttp import web
+
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "0.0.0.0", self.http_port)
+        await site.start()
+        self.http_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+    async def stop_async(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()  # fires _on_cleanup
+            self._runner = None
+
+    def run(self) -> None:
+        """Blocking entrypoint (``kft gateway run``)."""
+
+        async def main():
+            await self.start_async()
+            try:
+                while True:
+                    await asyncio.sleep(3600)
+            finally:
+                await self.stop_async()
+
+        asyncio.run(main())

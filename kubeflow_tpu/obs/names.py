@@ -60,7 +60,40 @@ TRAIN_DEVICE_STEP_MS = "kubeflow_tpu_train_device_step_ms"
 TRAIN_COMPILE_MS = "kubeflow_tpu_train_compile_ms"
 TRAIN_STEPS_PER_SEC = "kubeflow_tpu_train_steps_per_sec"
 
+# -- inference gateway (gateway/) --------------------------------------- #
+
+#: counter{service,code} — requests answered at the edge, by HTTP status
+GATEWAY_REQUESTS_TOTAL = "kft_gateway_requests_total"
+#: histogram{service} — edge-observed request latency (activator queue
+#: time included: the client experienced it)
+GATEWAY_LATENCY_SECONDS = "kft_gateway_latency_seconds"
+#: gauge{service} — requests parked in the activator FIFO right now
+GATEWAY_QUEUE_DEPTH = "kft_gateway_queue_depth"
+#: counter{service,reason} — requests shed at the edge
+#: (rate_limit / inflight_cap / queue_full / activation_timeout / no_backend)
+GATEWAY_SHED_TOTAL = "kft_gateway_shed_total"
+#: counter{service} — transparent re-dispatches after a backend failure
+GATEWAY_RETRIES_TOTAL = "kft_gateway_retries_total"
+#: counter{service} — hedged second requests dispatched
+GATEWAY_HEDGES_TOTAL = "kft_gateway_hedges_total"
+#: counter{service} — requests routed by prefix/session affinity
+GATEWAY_AFFINITY_ROUTED_TOTAL = "kft_gateway_affinity_routed_total"
+#: gauge{backend} — 1 while the backend's circuit breaker is open/half-open
+GATEWAY_BREAKER_OPEN = "kft_gateway_breaker_open"
+#: counter{backend} — closed→open breaker transitions
+GATEWAY_BREAKER_OPENS_TOTAL = "kft_gateway_breaker_opens_total"
+#: gauge{service} — backends currently eligible for selection
+GATEWAY_BACKENDS_READY = "kft_gateway_backends_ready"
+#: counter{service} — scale-from-zero kicks issued by the activator
+GATEWAY_ACTIVATIONS_TOTAL = "kft_gateway_activations_total"
+
 # -- serving ------------------------------------------------------------ #
+
+#: gauge{model} — requests currently executing in the dataplane (the
+#: load signal the gateway's least-outstanding balancer cross-checks)
+SERVER_INFLIGHT = "kft_server_inflight"
+#: gauge{model} — instances waiting in the batcher queue
+SERVER_QUEUE_DEPTH = "kft_server_queue_depth"
 
 #: counter{model} — model loads that raised (ModelMesh)
 MODELMESH_LOAD_FAILURES_TOTAL = "kft_modelmesh_load_failures_total"

@@ -61,6 +61,7 @@ class DashboardServer(ThreadedAiohttpServer):
         pipeline_api=None,  # pipelines.api.PipelineAPIServer → DAG view
         volumes=None,       # platform.volumes.VolumeController → /api/volumes
         registry=None,      # registry.store.ModelStore → /api/models
+        gateway=None,       # gateway.server.InferenceGateway → /api/gateway
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -74,6 +75,7 @@ class DashboardServer(ThreadedAiohttpServer):
         self.pipeline_api = pipeline_api
         self.volumes = volumes
         self.registry = registry
+        self.gateway = gateway
 
     # -- views ---------------------------------------------------------- #
 
@@ -211,6 +213,13 @@ class DashboardServer(ThreadedAiohttpServer):
             }
             for v in self.registry.list_versions(name)
         ]
+
+    def gateway_view(self) -> dict:
+        """Edge topology (the Istio/Knative console analog): per-service
+        routes with canary split + affinity mode, live backend fitness
+        (probe/breaker/outstanding), activator queue depths, tenant
+        policy. Empty when no gateway is attached."""
+        return {} if self.gateway is None else self.gateway.state_view()
 
     def pipelines_view(self) -> list[dict]:
         return [] if self.lineage is None else self.lineage.runs()
@@ -435,6 +444,7 @@ class DashboardServer(ThreadedAiohttpServer):
         app.router.add_get("/api/summary", handler(self.summary_view))
         app.router.add_get("/api/jobs", handler(self.jobs_view))
         app.router.add_get("/api/queues", handler(self.queues_view))
+        app.router.add_get("/api/gateway", handler(self.gateway_view))
         app.router.add_get("/api/profiles", handler(self.profiles_view))
         app.router.add_get("/api/notebooks", handler(self.notebooks_view))
         app.router.add_get("/api/tensorboards", handler(self.tensorboards_view))
@@ -517,7 +527,7 @@ _INDEX_HTML = """<!doctype html>
 <header><h1>kubeflow-tpu</h1><nav id="nav"></nav></header>
 <main id="main"></main>
 <script>
-const tabs=["summary","jobs","queues","experiments","pipelines","models","notebooks","volumes","tensorboards","profiles"];
+const tabs=["summary","jobs","queues","gateway","experiments","pipelines","models","notebooks","volumes","tensorboards","profiles"];
 let tab="summary";
 const $=(h)=>{const d=document.createElement("div");d.innerHTML=h;return d};
 const esc=(s)=>String(s).replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
@@ -562,6 +572,16 @@ async function render(){nav();const m=document.getElementById("main");m.textCont
    localqueues:(r.local_queues||[]).join(", ")||"—"}));
   m.innerHTML=`<div class="bar"><i>ClusterQueues: nominal quota, live usage, cohort borrowing, admission wait</i></div>`+
    table(rows,["name","cohort","nominal","used","borrowed","limit","pending","admitted","wait p50/p95","localqueues"])}
+ if(tab==="gateway"){const g=await j("/api/gateway");
+  if(!g.services||!g.services.length){m.innerHTML="<p>no gateway attached</p>"}else{
+  const svc=g.services.map(s=>({name:s.name,canary:`${s.canary_percent}%`,affinity:s.affinity,
+   ready:s.ready_backends,queued:s.queue_depth,hosts:(s.hosts||[]).join(", ")||"—"}));
+  const bes=g.services.flatMap(s=>(s.backends||[]).map(b=>({service:s.name,url:b.url,
+   revision:b.revision,state:pill(b.state),probe:b.probe_ok?"ok":"ejected",
+   breaker:pill(b.breaker),outstanding:b.outstanding})));
+  m.innerHTML=`<div class="bar"><i>edge routes, backend fitness, activator queues</i></div>`+
+   `<h3>services</h3>`+table(svc,["name","canary","affinity","ready","queued","hosts"])+
+   `<h3>backends</h3>`+table(bes,["service","url","revision","state","probe","breaker","outstanding"])}}
  if(tab==="experiments"){const rows=(await j("/api/experiments")).map(r=>({...r,
    name:raw(`<a href="#" onclick="trials('${uenc(r.name)}');return false">${esc(r.name)}</a>`)}));
   m.innerHTML=table(rows,["name","trials","succeeded","failed","running"])+`<pre id="detail" hidden></pre>`}

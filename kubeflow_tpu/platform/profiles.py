@@ -21,10 +21,22 @@ from kubeflow_tpu.orchestrator.webhooks import AdmissionError
 
 @dataclasses.dataclass(frozen=True)
 class ResourceQuota:
-    """Per-namespace ceilings; None = unlimited."""
+    """Per-namespace ceilings; None = unlimited.
+
+    The serving fields are read by the inference gateway's
+    ``PolicyEngine.from_profiles`` (gateway/policy.py): the same profile
+    that caps a tenant's training chips caps its edge traffic — the Istio
+    authz + local-rate-limit half of the reference's profile contract.
+    """
 
     max_chips: int | None = None
     max_jobs: int | None = None
+    #: serving: sustained requests/second at the gateway (token bucket)
+    max_rps: float | None = None
+    #: serving: token-bucket burst size (default: max(1, max_rps))
+    burst: int | None = None
+    #: serving: concurrent in-flight requests at the gateway
+    max_concurrent_requests: int | None = None
 
 
 @dataclasses.dataclass

@@ -46,6 +46,12 @@ class Batcher:
         self.stats = {"batches": 0, "instances": 0, "fail_isolations": 0}
 
     @property
+    def queue_depth(self) -> int:
+        """Instances waiting for the next flush — the balancer's backlog
+        signal, exported as ``kft_server_queue_depth`` on /metrics."""
+        return sum(len(i) for i, _ in self._queue)
+
+    @property
     def mean_occupancy(self) -> float:
         """Mean instances per handler call — how full the MXU batches run.
 

@@ -23,6 +23,7 @@ import random
 import time
 from typing import Any
 
+from kubeflow_tpu.gateway.router import canary_slot
 from kubeflow_tpu.serve.model import Model, retire as _retire
 from kubeflow_tpu.serve.spec import (
     InferenceServiceSpec,
@@ -85,6 +86,7 @@ class InferenceServiceController:
         model_dir: str = "/tmp/kubeflow_tpu_models",
         idle_scale_to_zero_s: float = 30.0,
         rng: random.Random | None = None,
+        canary_salt: str = "kft-canary",
         model_mesh=None,
     ):
         self.registry = registry
@@ -92,6 +94,9 @@ class InferenceServiceController:
         self.idle_scale_to_zero_s = idle_scale_to_zero_s
         self._services: dict[str, ServiceState] = {}
         self._rng = rng or random.Random(0)
+        #: salts the per-request-id canary hash (same split family the
+        #: gateway uses at the edge) — seedable so tests pin the cohort
+        self.canary_salt = canary_salt
         #: optional ModelMesh (serve/modelmesh.py): when set, predictors are
         #: REGISTERED rather than loaded — N services share one HBM budget
         #: with on-demand load + LRU eviction (SURVEY.md §2.2 ModelMesh row)
@@ -230,8 +235,20 @@ class InferenceServiceController:
 
     # -- traffic / autoscaling ---------------------------------------------
 
-    def route(self, name: str, namespace: str = "default") -> Model:
-        """Pick default vs canary per the traffic split; handles cold start."""
+    def route(
+        self,
+        name: str,
+        namespace: str = "default",
+        request_id: str | None = None,
+    ) -> Model:
+        """Pick default vs canary per the traffic split; handles cold start.
+
+        With a ``request_id`` the split is a deterministic salted hash of
+        the id (exactly the gateway's edge decision): a retried request
+        re-hashes to the same revision and cannot flap mid-rollout, while
+        the split stays exactly pct in expectation over distinct ids.
+        Without an id the seeded-RNG fallback preserves the old behavior.
+        """
         st = self.get(name, namespace)
         rs = st.replicas
         now = time.monotonic()
@@ -242,8 +259,13 @@ class InferenceServiceController:
                 st.default_model.load()
         rs.last_request_ts = now
         pct = st.spec.predictor.canary_traffic_percent
-        if st.canary_model is not None and self._rng.uniform(0, 100) < pct:
-            return st.canary_model
+        if st.canary_model is not None:
+            if request_id is not None:
+                take_canary = canary_slot(request_id, self.canary_salt) < pct
+            else:
+                take_canary = self._rng.uniform(0, 100) < pct
+            if take_canary:
+                return st.canary_model
         return st.default_model
 
     def promote_canary(self, name: str, namespace: str = "default") -> None:

@@ -91,6 +91,7 @@ class BertRuntimeModel(JAXModel):
         config: BertConfig | None = None,
         buckets: BucketSpec | None = None,
         sharding: jax.sharding.Sharding | None = None,
+        **config_overrides: Any,
     ):
         from kubeflow_tpu.models.convert import is_hf_bert_dir
 
@@ -109,6 +110,13 @@ class BertRuntimeModel(JAXModel):
             )
         else:
             cfg = bert_base()
+        if config_overrides:
+            # manifest `extra` keys (e.g. attn_impl: reference on a CPU
+            # deployment) override single config fields without a custom
+            # factory; typos fail loudly via dataclasses.replace
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, **config_overrides)
         model = BertForMaskedLM(cfg)
         self.config = cfg
         vocab_file = (

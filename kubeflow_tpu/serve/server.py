@@ -77,6 +77,13 @@ class DataPlane:
         self._batchers: dict[str, Batcher] = {}
         self.logger = logger
         self.metrics: dict[str, Any] = {"requests_total": {}, "latency_ms": {}}
+        #: requests currently executing, per model — the load signal the
+        #: gateway's least-outstanding balancer cross-checks, and what
+        #: graceful drain waits on (event-loop confined)
+        self.inflight: dict[str, int] = {}
+
+    def total_inflight(self) -> int:
+        return sum(self.inflight.values())
 
     # -- registry -----------------------------------------------------------
 
@@ -148,12 +155,16 @@ class DataPlane:
         if self.logger is not None:
             self.logger.log_request(name, req_id, payload)
         t0 = time.perf_counter()
-        batcher = self._batchers.get(name)
-        if batcher is not None and isinstance(payload, dict) and "instances" in payload:
-            preds = await batcher.submit(list(payload["instances"]))
-            result: Any = {"predictions": preds}
-        else:
-            result = await model(payload, headers)
+        self.inflight[name] = self.inflight.get(name, 0) + 1
+        try:
+            batcher = self._batchers.get(name)
+            if batcher is not None and isinstance(payload, dict) and "instances" in payload:
+                preds = await batcher.submit(list(payload["instances"]))
+                result: Any = {"predictions": preds}
+            else:
+                result = await model(payload, headers)
+        finally:
+            self.inflight[name] -= 1
         dt = (time.perf_counter() - t0) * 1e3
         self.metrics["requests_total"][name] = self.metrics["requests_total"].get(name, 0) + 1
         # bounded reservoir: long-lived servers must not accumulate a sample
@@ -182,9 +193,15 @@ class ModelServer:
         grpc_port: int | None = None,
         logger: RequestLogger | None = None,
         batcher: BatcherConfig | None = None,
+        drain_grace_s: float = 10.0,
     ):
         self.http_port = http_port
         self.grpc_port = grpc_port
+        #: graceful-drain budget: on stop, readiness flips to 503 first
+        #: (load balancers stop sending), then in-flight work gets this
+        #: long to finish before teardown — lossless rolling restarts
+        self.drain_grace_s = drain_grace_s
+        self._draining = False
         # cold start is compile-dominated (BASELINE config 5): persist XLA
         # compiles so every server start after the first skips them
         from kubeflow_tpu.core.compcache import enable_compilation_cache
@@ -323,6 +340,10 @@ class ModelServer:
             }
         )
         await resp.prepare(req)
+        # streams occupy engine rows: they count as in-flight for the
+        # drain wait and the kft_server_inflight load signal
+        dp_inflight = self.dataplane.inflight
+        dp_inflight[name] = dp_inflight.get(name, 0) + 1
         loop = asyncio.get_running_loop()
         frames: asyncio.Queue = asyncio.Queue()
         disconnected = threading.Event()
@@ -372,6 +393,7 @@ class ModelServer:
             disconnected.set()  # pump stops; generator close frees the row
             raise
         finally:
+            dp_inflight[name] -= 1
             dt = (time.perf_counter() - t0) * 1e3
             m = self.dataplane.metrics
             m["requests_total"][name] = m["requests_total"].get(name, 0) + 1
@@ -419,6 +441,13 @@ class ModelServer:
         return web.json_response(result)
 
     async def _v2_ready(self, req: web.Request) -> web.Response:
+        if self._draining:
+            # drain protocol: readiness goes 503 FIRST so balancers stop
+            # routing here, while in-flight (and straggler) requests still
+            # complete during the grace window
+            return web.json_response(
+                {"ready": False, "draining": True}, status=503
+            )
         ready = all(self.dataplane.get(n).ready for n in self.dataplane.list_models())
         return web.json_response({"ready": ready})
 
@@ -461,6 +490,15 @@ class ModelServer:
                 p99 = srt[min(len(srt) - 1, int(len(srt) * 0.99))]
                 lines.append(f'{names.LATENCY_P50_MS}{{model="{name}"}} {p50:.3f}')
                 lines.append(f'{names.LATENCY_P99_MS}{{model="{name}"}} {p99:.3f}')
+        # live load signals for the gateway's least-outstanding balancer
+        for name in self.dataplane.list_models():
+            n = self.dataplane.inflight.get(name, 0)
+            lines.append(f'{names.SERVER_INFLIGHT}{{model="{name}"}} {n}')
+        for name, b in sorted(self.dataplane._batchers.items()):
+            lines.append(
+                f'{names.SERVER_QUEUE_DEPTH}{{model="{name}"}} '
+                f"{b.queue_depth}"
+            )
         # batcher occupancy gauges, matching the engine's pool gauges
         for name, b in sorted(self.dataplane._batchers.items()):
             lines.append(
@@ -542,6 +580,17 @@ class ModelServer:
             self.grpc_port = self._grpc.start()
 
     async def stop_async(self) -> None:
+        # graceful drain: readiness flips to 503 immediately (balancers
+        # stop sending), then in-flight work gets a bounded grace window
+        # before the listeners tear down — a rolling restart behind the
+        # gateway loses zero requests
+        self._draining = True
+        deadline = time.monotonic() + self.drain_grace_s
+        while (
+            self.dataplane.total_inflight() > 0
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.02)
         if self._grpc is not None:
             # stop_async drains on an executor thread: a blocking stop() here
             # would park the shared event loop, so in-flight RPCs waiting on

@@ -102,6 +102,9 @@ class InferenceServiceSpec:
                 min_replicas=int(d.get("minReplicas", 1)),
                 max_replicas=int(d.get("maxReplicas", max(1, int(d.get("minReplicas", 1))))),
                 scale_target=int(d.get("scaleTarget", 1)),
+                # runtime-specific kwargs ride the manifest (the controller
+                # already forwards extra to factories; kft serve does too)
+                extra=dict(model.get("extra", {})),
             )
             if klass is PredictorSpec:
                 kw["canary_traffic_percent"] = int(

@@ -278,4 +278,117 @@ print("quota preempt OK: borrower evicted (143), reclaimer ran, "
       "borrower resumed; kft_preemptions_total asserted")
 EOF
 
+echo "== gateway: SIGKILL one of two backends mid-burst, zero failures =="
+python - <<'EOF'
+import json, os, subprocess, sys, tempfile, time, urllib.request
+
+tmp = tempfile.mkdtemp(prefix="kft-smoke-gw-")
+isvc = os.path.join(tmp, "isvc.yaml")
+with open(isvc, "w") as f:
+    f.write(
+        "apiVersion: serving.kubeflow.org/v1beta1\n"
+        "kind: InferenceService\n"
+        "metadata: {name: echo}\n"
+        "spec:\n"
+        "  predictor:\n"
+        "    model:\n"
+        "      modelFormat: {name: bert-tiny}\n"
+        "      extra: {attn_impl: reference}\n"  # CPU smoke: no pallas
+    )
+env = {**os.environ, "PYTHONUNBUFFERED": "1"}
+
+
+def wait_port(pf, proc, log):
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if os.path.exists(pf) and open(pf).read().strip():
+            return int(open(pf).read())
+        if proc.poll() is not None:
+            sys.exit(f"process died early:\n{open(log, errors='replace').read()}")
+        time.sleep(0.1)
+    sys.exit("process never bound a port")
+
+
+procs = []
+try:
+    ports = []
+    for i in range(2):  # two real ModelServer replicas via the CLI
+        pf = os.path.join(tmp, f"port{i}")
+        log = os.path.join(tmp, f"srv{i}.log")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu", "serve", "-f", isvc,
+             "--http-port", "0", "--port-file", pf],
+            stdout=open(log, "wb"), stderr=subprocess.STDOUT, env=env,
+        )
+        procs.append(p)
+        ports.append((pf, p, log))
+    ports = [wait_port(pf, p, log) for pf, p, log in ports]
+
+    gw_yaml = os.path.join(tmp, "gw.yaml")
+    with open(gw_yaml, "w") as f:  # YAML is a JSON superset
+        json.dump({
+            "kind": "InferenceGateway", "metadata": {"name": "edge"},
+            "spec": {
+                "failureThreshold": 2, "probeIntervalS": 2.0,
+                "retryBudgetFloor": 30,
+                "services": [{"name": "echo", "backends": [
+                    f"http://127.0.0.1:{ports[0]}",
+                    f"http://127.0.0.1:{ports[1]}",
+                ]}],
+            },
+        }, f)
+    gpf = os.path.join(tmp, "gwport")
+    gwlog = os.path.join(tmp, "gw.log")
+    gw = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu", "gateway", "run",
+         "-f", gw_yaml, "--http-port", "0", "--port-file", gpf],
+        stdout=open(gwlog, "wb"), stderr=subprocess.STDOUT, env=env,
+    )
+    procs.append(gw)
+    gwport = wait_port(gpf, gw, gwlog)
+
+    def predict(i):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gwport}/v1/models/echo:predict",
+            data=json.dumps({"instances": ["the [mask] runs"]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-request-id": f"smoke-{i}"},
+        )
+        with urllib.request.urlopen(req, timeout=180) as r:
+            return json.loads(r.read())
+
+    for i in range(4):  # warm both replicas through the compile
+        assert "predictions" in predict(i)
+
+    from kubeflow_tpu.chaos.injectors import kill_backend
+
+    kill_backend(procs[1].pid)  # SIGKILL one replica, burst immediately
+    for i in range(20):
+        out = predict(100 + i)
+        assert "predictions" in out, out
+
+    metrics = urllib.request.urlopen(
+        f"http://127.0.0.1:{gwport}/metrics", timeout=30
+    ).read().decode()
+
+    def metric(prefix):
+        for ln in metrics.splitlines():
+            if ln.startswith(prefix):
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    retries = metric('kft_gateway_retries_total{service="echo"}')
+    opens = metric(
+        f'kft_gateway_breaker_opens_total{{backend="http://127.0.0.1:{ports[1]}"}}'
+    )
+    assert retries >= 1, f"no transparent retries observed:\n{metrics}"
+    assert opens >= 1, f"breaker never opened for the dead backend:\n{metrics}"
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+print(f"gateway OK: 20-request burst clean over a dead backend, "
+      f"retries={retries:.0f} breaker_opens={opens:.0f}")
+EOF
+
 echo "smoke OK"

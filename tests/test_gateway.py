@@ -1,0 +1,990 @@
+"""Inference gateway tests: deterministic edge routing, backend fitness,
+activator buffering, tenant policy, and the proxy e2e against real
+``ModelServer`` replicas (SURVEY.md §2.2 — the Istio ingress + Knative
+activator half of the KServe request path)."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from kubeflow_tpu.gateway.activator import (
+    ActivationTimeout,
+    Activator,
+    QueueOverflow,
+)
+from kubeflow_tpu.gateway.backends import (
+    BackendPool,
+    BreakerConfig,
+    CircuitBreaker,
+)
+from kubeflow_tpu.gateway.policy import (
+    PolicyEngine,
+    RateLimited,
+    RetryBudget,
+    TokenBucket,
+    TooManyInFlight,
+)
+from kubeflow_tpu.gateway.router import (
+    HashRing,
+    RouteTable,
+    ServiceRoute,
+    affinity_key_of,
+    canary_slot,
+    pick_revision,
+)
+from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+from kubeflow_tpu.obs.prom import REGISTRY
+from kubeflow_tpu.serve.model import EchoModel, Model
+from kubeflow_tpu.serve.server import ModelServer
+from kubeflow_tpu.serve.spec import (
+    InferenceServiceSpec,
+    PredictorSpec,
+    RuntimeRegistry,
+    ServingRuntime,
+)
+
+
+def _metric(name, **labels):
+    m = REGISTRY._metrics.get(name)
+    if m is None:
+        return 0.0
+    child = m._children.get(tuple(sorted(labels.items())))
+    return child.value if child else 0.0
+
+
+# ------------------------------------------------------------------ router
+
+
+def test_canary_split_deterministic_and_within_2pct():
+    """The acceptance split: over 1k hashed ids the edge decision lands
+    within ±2% of the configured pct, and a given id NEVER flaps."""
+    ids = [f"req-{i}" for i in range(1000)]
+    picks = [pick_revision(i, 30) for i in ids]
+    frac = 100.0 * sum(p == "canary" for p in picks) / len(picks)
+    assert 28.0 <= frac <= 32.0, frac
+    # a retried request re-hashes identically: no revision flap mid-rollout
+    for i in ids[:50]:
+        assert all(pick_revision(i, 30) == pick_revision(i, 30) for _ in range(5))
+    # the salt re-shuffles the cohort without changing the split family
+    resalted = [pick_revision(i, 30, "other-salt") for i in ids]
+    assert resalted != picks
+    assert 27.0 <= 100.0 * sum(p == "canary" for p in resalted) / 1000 <= 33.0
+
+
+def test_canary_slot_boundaries():
+    assert all(0.0 <= canary_slot(f"x{i}") < 100.0 for i in range(200))
+    assert pick_revision("anything", 0) == "default"
+    # pct=100 is a full rollout: everything takes the canary
+    assert pick_revision("anything", 100) == "canary"
+
+
+def test_route_table_host_path_and_model_fallback():
+    t = RouteTable()
+    t.upsert(ServiceRoute(name="echo", hosts=("echo.default",),
+                          path_prefixes=("/edge/echo",)))
+    t.upsert(ServiceRoute(name="lm"))
+    # exact host (port stripped) and Knative-style first-label match
+    r, p = t.resolve("echo.default:8081", "/v1/models/m:predict")
+    assert r.name == "echo" and p == "/v1/models/m:predict"
+    r, _ = t.resolve("echo.default.example.com", "/v1/models/m:predict")
+    assert r.name == "echo"
+    # path prefix strips before forwarding
+    r, p = t.resolve(None, "/edge/echo/v1/models/m:predict")
+    assert r.name == "echo" and p == "/v1/models/m:predict"
+    # model-name fallback: the v1/v2 path names a registered service
+    r, p = t.resolve("localhost", "/v2/models/lm/infer")
+    assert r.name == "lm" and p == "/v2/models/lm/infer"
+    assert t.resolve("localhost", "/v1/models/unknown:predict") is None
+
+
+def test_hash_ring_sticky_and_minimal_motion():
+    urls = tuple(f"http://b{i}" for i in range(4))
+    ring = HashRing(urls)
+    keys = [f"prefix:{i}" for i in range(300)]
+    before = {k: ring.pick(k) for k in keys}
+    assert all(ring.pick(k) == before[k] for k in keys)  # sticky
+    assert len(set(before.values())) == 4  # all backends used
+    # removing one backend remaps ONLY the keys that hashed to it
+    ring3 = HashRing(urls[:3])
+    moved = sum(
+        1 for k in keys if before[k] != "http://b3" and ring3.pick(k) != before[k]
+    )
+    assert moved == 0
+    assert all(ring3.pick(k) in urls[:3] for k in keys)
+
+
+def test_affinity_key_prefix_and_session():
+    r = ServiceRoute(name="lm", affinity="prefix", affinity_prefix_tokens=4)
+    same_a = affinity_key_of(r, {}, {"instances": [{"ids": [1, 2, 3, 4, 9]}]})
+    same_b = affinity_key_of(r, {}, {"instances": [{"ids": [1, 2, 3, 4, 77]}]})
+    other = affinity_key_of(r, {}, {"instances": [{"ids": [5, 6, 7, 8, 9]}]})
+    assert same_a == same_b and same_a != other  # prefix-keyed, not whole-prompt
+    assert affinity_key_of(r, {}, {"instances": [{"prompt": "hello world"}]})
+    # session header wins over the prompt
+    sk = affinity_key_of(r, {"x-session-id": "s1"}, {"instances": [[1, 2]]})
+    assert sk == "session:s1"
+    rs = ServiceRoute(name="lm", affinity="session")
+    assert affinity_key_of(rs, {}, {"instances": [[1]]}) is None
+    assert affinity_key_of(ServiceRoute(name="x"), {}, {"instances": [[1]]}) is None
+
+
+# ---------------------------------------------------------------- backends
+
+
+def test_circuit_breaker_open_half_open_close():
+    clk = [0.0]
+    br = CircuitBreaker(
+        BreakerConfig(failure_threshold=2, recovery_s=5.0),
+        clock=lambda: clk[0],
+    )
+    assert br.allow()
+    assert br.record_failure() is False  # 1 of 2
+    assert br.record_failure() is True  # trips open
+    assert br.state == "open" and not br.allow()
+    clk[0] = 5.1  # recovery elapsed → half-open, ONE trial
+    assert br.allow() is True
+    assert br.allow() is False  # second concurrent trial blocked
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    # a half-open trial that fails re-opens without counting a new trip
+    br.record_failure()
+    br.record_failure()
+    clk[0] = 11.0
+    assert br.allow()
+    assert br.record_failure() is False
+    assert br.state == "open"
+
+
+def test_pool_least_outstanding_with_rotation_and_revisions():
+    pool = BackendPool()
+    b1 = pool.add("svc", "http://a")
+    b2 = pool.add("svc", "http://b")
+    pool.add("svc", "http://c", revision="canary")
+    b1.outstanding = 2
+    assert pool.pick("svc", "default") is b2
+    b2.outstanding = 2
+    # ties rotate deterministically (a counter, not RNG)
+    seen = {pool.pick("svc", "default").url for _ in range(4)}
+    assert seen == {"http://a", "http://b"}
+    assert pool.pick("svc", "canary").url == "http://c"
+
+
+def test_pool_breaker_drives_selection_and_half_open_trial():
+    clk = [0.0]
+    pool = BackendPool(
+        breaker=BreakerConfig(failure_threshold=1, recovery_s=2.0), clock=lambda: clk[0]
+    )
+    b1 = pool.add("svc", "http://a")
+    b2 = pool.add("svc", "http://b")
+    opens0 = _metric("kft_gateway_breaker_opens_total", backend="http://a")
+    pool.record(b1, ok=False)  # trips immediately (threshold 1)
+    assert _metric("kft_gateway_breaker_opens_total", backend="http://a") == opens0 + 1
+    assert _metric("kft_gateway_breaker_open", backend="http://a") == 1
+    assert all(pool.pick("svc") is b2 for _ in range(4))  # open backend skipped
+    pool.record(b2, ok=False)  # both tripped: nothing closed…
+    clk[0] = 2.5  # …but recovery elapsed: half-open grants a trial
+    trial = pool.pick("svc")
+    assert trial is not None
+    pool.record(trial, ok=True)  # trial succeeds → breaker closes
+    assert trial.breaker.state == "closed"
+    assert _metric("kft_gateway_breaker_open", backend=trial.url) == 0
+
+
+def test_pool_probe_ejection_and_recovery():
+    events = []
+    pool = BackendPool(eject_threshold=2, on_ready=events.append)
+    b = pool.add("svc", "http://a")
+    pool.observe_probe(b, False)
+    assert b.probe_ok  # one failure is not an outlier yet
+    pool.observe_probe(b, False)
+    assert not b.probe_ok and pool.selectable("svc") == []
+    pool.observe_probe(b, True)  # first passing probe re-admits
+    assert b.probe_ok and pool.ready_count("svc") == 1
+    assert "svc" in events  # the activator flush signal fired
+
+
+def test_pool_drain_removes_after_last_release():
+    pool = BackendPool()
+    b = pool.add("svc", "http://a")
+    pool.acquire(b)
+    pool.drain("http://a")
+    assert pool.selectable("svc") == []  # no NEW traffic immediately
+    assert pool.backends_of("svc") == [b]  # still present: one in flight
+    pool.release(b)
+    assert pool.backends_of("svc") == []  # removed on the last release
+
+
+# --------------------------------------------------------------- activator
+
+
+def test_activator_flushes_in_admission_order_and_kicks_once():
+    kicks = []
+    order = []
+
+    async def run():
+        act = Activator(queue_limit=8, timeout_s=5.0, scale_up=kicks.append)
+
+        async def waiter(i):
+            await act.wait("svc")
+            order.append(i)
+
+        tasks = [asyncio.ensure_future(waiter(i)) for i in range(4)]
+        await asyncio.sleep(0.05)
+        assert act.depth("svc") == 4
+        assert kicks == ["svc"]  # one kick per cold episode, not per request
+        act.notify("svc")
+        await asyncio.gather(*tasks)
+        assert order == [0, 1, 2, 3]  # strict FIFO admission order
+        # next cold episode kicks again
+        t = asyncio.ensure_future(waiter(9))
+        await asyncio.sleep(0.02)
+        assert kicks == ["svc", "svc"]
+        act.notify("svc")
+        await t
+
+    asyncio.run(run())
+
+
+def test_activator_overflow_and_deadline_envelopes():
+    async def run():
+        act = Activator(queue_limit=1, timeout_s=0.05)
+        t1 = asyncio.ensure_future(act.wait("svc"))
+        await asyncio.sleep(0.01)
+        with pytest.raises(QueueOverflow):  # bounded FIFO → the 429 path
+            await act.wait("svc")
+        with pytest.raises(ActivationTimeout):  # deadline → the 503 path
+            await t1
+        assert act.depth("svc") == 0  # expired waiter left no residue
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------ policy
+
+
+def test_token_bucket_and_policy_from_profiles():
+    from kubeflow_tpu.platform.profiles import Profile, ResourceQuota
+
+    clk = [0.0]
+    tb = TokenBucket(2.0, 2, clock=lambda: clk[0])
+    assert tb.allow() and tb.allow() and not tb.allow()
+    clk[0] = 0.5  # 1 token refilled
+    assert tb.allow() and not tb.allow()
+
+    class _Profiles:
+        def list(self):
+            return [
+                Profile("team-a", "o", quota=ResourceQuota(
+                    max_rps=2.0, burst=2, max_concurrent_requests=1)),
+                Profile("team-b", "o", quota=ResourceQuota(max_chips=8)),
+            ]
+
+    eng = PolicyEngine.from_profiles(_Profiles(), clock=lambda: clk[0])
+    eng.acquire("team-a")  # token 1 of the burst
+    with pytest.raises(TooManyInFlight):  # cap rejection burns NO token
+        eng.acquire("team-a")
+    eng.release("team-a")
+    eng.acquire("team-a")  # token 2
+    eng.release("team-a")
+    with pytest.raises(RateLimited):  # burst drained, clock frozen
+        eng.acquire("team-a")
+    eng.acquire("team-b")  # no serving quota → unmanaged
+    eng.acquire("unknown")  # unknown tenant → unmanaged
+
+
+def test_retry_budget_floor_then_ratio():
+    rb = RetryBudget(ratio=0.5, floor=2)
+    assert rb.try_spend() and rb.try_spend() and not rb.try_spend()
+    for _ in range(4):
+        rb.on_request()
+    assert rb.try_spend() and rb.try_spend()  # 2 + 0.5*4 = 4 allowed
+    assert not rb.try_spend()
+
+
+# -------------------------------------------------- controller satellites
+
+
+def _registry(fmt="echo", factory=None):
+    reg = RuntimeRegistry()
+    reg.register(ServingRuntime(
+        name=f"{fmt}-rt", supported_formats=(fmt,),
+        factory=factory or (lambda name, path, **kw: EchoModel(name)),
+    ))
+    return reg
+
+
+def _canary_controller(tmp_path, fmt="echo"):
+    from kubeflow_tpu.serve.controller import InferenceServiceController
+
+    ctl = InferenceServiceController(_registry(fmt), model_dir=str(tmp_path))
+    ctl.apply(InferenceServiceSpec(
+        "svc", PredictorSpec(model_format=fmt)))
+    ctl.apply(InferenceServiceSpec(
+        "svc", PredictorSpec(model_format=fmt, canary_traffic_percent=30,
+                             extra={"rollout": 2})))
+    return ctl
+
+
+def test_controller_route_hashes_request_id_deterministically(tmp_path):
+    ctl = _canary_controller(tmp_path)
+    st = ctl.get("svc")
+    assert st.canary_model is not None
+    # the same request id ALWAYS routes to the same revision (retry-stable)
+    for i in range(30):
+        rid = f"r-{i}"
+        first = ctl.route("svc", request_id=rid)
+        assert all(ctl.route("svc", request_id=rid) is first for _ in range(5))
+    # split tracks pct in expectation over distinct ids
+    picks = [ctl.route("svc", request_id=f"r-{i}") for i in range(1000)]
+    frac = 100.0 * sum(p is st.canary_model for p in picks) / len(picks)
+    assert 27.0 <= frac <= 33.0, frac
+    # matches the gateway's edge decision exactly (same hash family)
+    expected = [
+        pick_revision(f"r-{i}", 30, ctl.canary_salt) == "canary"
+        for i in range(1000)
+    ]
+    assert [p is st.canary_model for p in picks] == expected
+    # no id → seeded RNG fallback still works
+    rng_picks = {id(ctl.route("svc")) for _ in range(100)}
+    assert len(rng_picks) == 2
+
+
+def test_route_table_fed_from_controller_state(tmp_path):
+    ctl = _canary_controller(tmp_path)
+    t = RouteTable()
+    t.update_from_controller(ctl)
+    r = t.get("svc")
+    assert r is not None
+    assert r.hosts == ("svc.default",)
+    assert r.canary_percent == 30.0 and r.affinity == "none"
+    # LM-engine predictors get prefix affinity switched on automatically
+    ctl_lm = _canary_controller(tmp_path / "lm", fmt="causal-lm-engine")
+    t.update_from_controller(ctl_lm)
+    assert t.get("svc").affinity == "prefix"
+    # a promoted canary (pct back to 100) stops splitting at the edge
+    ctl.promote_canary("svc")
+    t.update_from_controller(ctl)
+    assert t.get("svc").canary_percent == 0.0
+
+
+# ----------------------------------------------------------- proxy e2e
+
+
+class _Tagged(Model):
+    """Echo with a replica tag, so tests can see WHICH backend answered."""
+
+    def __init__(self, name, tag):
+        super().__init__(name)
+        self.tag = tag
+        self.ready = True
+
+    def predict(self, inputs, headers=None):
+        return {"predictions": [self.tag for _ in inputs["instances"]]}
+
+
+async def _backend(model_name="m", tag="a", **server_kw):
+    from aiohttp.test_utils import TestServer
+
+    ms = ModelServer([_Tagged(model_name, tag)], **server_kw)
+    srv = TestServer(ms.build_app())
+    await srv.start_server()
+    return ms, srv, f"http://127.0.0.1:{srv.port}"
+
+
+async def _gateway_client(gw):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(gw.build_app()))
+    await client.start_server()
+    return client
+
+
+def test_gateway_proxies_and_splits_canary_at_the_edge():
+    async def run():
+        _, srv_a, url_a = await _backend(tag="stable")
+        _, srv_b, url_b = await _backend(tag="canary")
+        gw = InferenceGateway(GatewayConfig(
+            salt="edge", probe_interval_s=30.0,
+            routes=[ServiceRoute(name="m", canary_percent=30.0)],
+            backends=[("m", url_a, "default"), ("m", url_b, "canary")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            got = []
+            for i in range(100):
+                r = await client.post(
+                    "/v1/models/m:predict",
+                    json={"instances": [[1]]},
+                    headers={"x-request-id": f"req-{i}"},
+                )
+                assert r.status == 200, await r.text()
+                got.append((await r.json())["predictions"][0])
+            # the split is EXACTLY the salted-hash decision, reproducible
+            expected = [
+                "canary" if pick_revision(f"req-{i}", 30, "edge") == "canary"
+                else "stable"
+                for i in range(100)
+            ]
+            assert got == expected
+            # same id re-sent → same revision (retry cannot flap)
+            r1 = await client.post("/v1/models/m:predict",
+                                   json={"instances": [[1]]},
+                                   headers={"x-request-id": "req-7"})
+            assert (await r1.json())["predictions"][0] == expected[7]
+        finally:
+            await client.close()
+            await srv_a.close()
+            await srv_b.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_scale_from_zero_parks_and_flushes_in_order():
+    """The activator acceptance: requests arriving with ZERO backends park
+    (no synchronous load in the request path), a scale-up is kicked, and
+    the queue flushes in admission order once the backend turns ready."""
+
+    async def run():
+        started = []
+        gw_box = {}
+
+        def scale_up(service):
+            async def spawn():
+                await asyncio.sleep(0.05)  # the "model load", off-path
+                ms, srv, url = await _backend(tag="cold")
+                started.append(srv)
+                gw_box["gw"].pool.add(service, url)  # ready → flush
+
+            asyncio.ensure_future(spawn())
+
+        gw = InferenceGateway(
+            GatewayConfig(
+                probe_interval_s=30.0, activation_timeout_s=5.0,
+                routes=[ServiceRoute(name="m")],
+            ),
+            scale_up=scale_up,
+        )
+        gw_box["gw"] = gw
+        client = await _gateway_client(gw)
+        try:
+            acts0 = _metric("kft_gateway_activations_total", service="m")
+
+            async def req(i):
+                r = await client.post(
+                    "/v1/models/m:predict", json={"instances": [[i]]},
+                    headers={"x-request-id": f"cold-{i}"},
+                )
+                return i, r.status, (await r.json())["predictions"][0]
+
+            tasks = [asyncio.ensure_future(req(i)) for i in range(3)]
+            await asyncio.sleep(0.01)
+            assert gw.activator.depth("m") == 3  # parked, not failed
+            results = await asyncio.gather(*tasks)
+            assert [s for _, s, _ in results] == [200, 200, 200]
+            assert all(tag == "cold" for _, _, tag in results)
+            assert _metric(
+                "kft_gateway_activations_total", service="m"
+            ) == acts0 + 1  # one kick for the whole cold episode
+        finally:
+            await client.close()
+            for srv in started:
+                await srv.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_activator_queue_full_429_and_deadline_503():
+    async def run():
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, queue_limit=1, activation_timeout_s=0.15,
+            routes=[ServiceRoute(name="m")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            t1 = asyncio.ensure_future(
+                client.post("/v1/models/m:predict", json={"instances": [[1]]})
+            )
+            await asyncio.sleep(0.03)
+            r2 = await client.post(
+                "/v1/models/m:predict", json={"instances": [[2]]}
+            )
+            assert r2.status == 429  # bounded FIFO overflow
+            r1 = await t1
+            assert r1.status == 503  # parked past the deadline
+            assert _metric("kft_gateway_shed_total",
+                           service="m", reason="queue_full") >= 1
+            assert _metric("kft_gateway_shed_total",
+                           service="m", reason="activation_timeout") >= 1
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_per_tenant_rate_limit_429_from_profiles_quota():
+    from kubeflow_tpu.platform.profiles import Profile, ResourceQuota
+
+    class _Profiles:
+        def list(self):
+            return [Profile("team-x", "o",
+                            quota=ResourceQuota(max_rps=0.01, burst=2))]
+
+    async def run():
+        _, srv, url = await _backend()
+        gw = InferenceGateway(
+            GatewayConfig(probe_interval_s=30.0,
+                          backends=[("m", url, "default")]),
+            policy=PolicyEngine.from_profiles(_Profiles()),
+        )
+        client = await _gateway_client(gw)
+        try:
+            hdr = {"x-kft-tenant": "team-x"}
+            for _ in range(2):  # burst
+                r = await client.post("/v1/models/m:predict",
+                                      json={"instances": [[1]]}, headers=hdr)
+                assert r.status == 200
+            r = await client.post("/v1/models/m:predict",
+                                  json={"instances": [[1]]}, headers=hdr)
+            assert r.status == 429
+            assert r.headers.get("Retry-After") == "1"
+            assert "rate" in (await r.text()).lower()
+            # other tenants are unmanaged by this profile
+            r = await client.post("/v1/models/m:predict",
+                                  json={"instances": [[1]]})
+            assert r.status == 200
+            assert _metric("kft_gateway_shed_total",
+                           service="m", reason="rate_limit") >= 1
+        finally:
+            await client.close()
+            await srv.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_prefix_affinity_pins_prompts_to_one_replica():
+    async def run():
+        _, srv_a, url_a = await _backend(tag="a")
+        _, srv_b, url_b = await _backend(tag="b")
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0,
+            routes=[ServiceRoute(name="m", affinity="prefix",
+                                 affinity_prefix_tokens=4)],
+            backends=[("m", url_a, "default"), ("m", url_b, "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            async def ask(ids):
+                r = await client.post("/v1/models/m:predict",
+                                      json={"instances": [{"ids": ids}]})
+                assert r.status == 200
+                return (await r.json())["predictions"][0]
+
+            # repeated prompts (same 4-token prefix) pin to ONE replica —
+            # that replica's engine prefix cache keeps hitting
+            tags = {await ask([1, 2, 3, 4, i]) for i in range(12)}
+            assert len(tags) == 1
+            # distinct prefixes spread over the ring
+            spread = {await ask([i, i + 1, i + 2, i + 3]) for i in range(16)}
+            assert spread == {"a", "b"}
+            assert _metric("kft_gateway_affinity_routed_total",
+                           service="m") >= 28
+        finally:
+            await client.close()
+            await srv_a.close()
+            await srv_b.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_gateway_backend_kill_mid_burst_invisible_to_clients():
+    """The chaos acceptance: SIGKILL-equivalent loss of one of two live
+    backends mid-burst — idempotent predicts retried transparently (zero
+    client-visible failures), the dead backend's breaker opens."""
+
+    async def run():
+        _, srv_a, url_a = await _backend(tag="a")
+        _, srv_b, url_b = await _backend(tag="b")
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, failure_threshold=2, recovery_s=60.0,
+            retry_budget_floor=50,
+            backends=[("m", url_a, "default"), ("m", url_b, "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            for _ in range(4):  # warm both replicas
+                r = await client.post("/v1/models/m:predict",
+                                      json={"instances": [[1]]})
+                assert r.status == 200
+            retries0 = _metric("kft_gateway_retries_total", service="m")
+            await srv_b.close()  # backend b dies with the burst in flight
+
+            async def one(i):
+                r = await client.post("/v1/models/m:predict",
+                                      json={"instances": [[i]]})
+                body = await r.json() if r.status == 200 else await r.text()
+                return r.status, body
+
+            results = await asyncio.gather(*[one(i) for i in range(20)])
+            assert [s for s, _ in results] == [200] * 20, results
+            assert all(b["predictions"][0] == "a" for _, b in results)
+            assert _metric("kft_gateway_retries_total",
+                           service="m") > retries0
+            assert _metric("kft_gateway_breaker_open",
+                           backend=url_b) == 1
+            # half-open recovery: unit-proven in
+            # test_pool_breaker_drives_selection_and_half_open_trial
+        finally:
+            await client.close()
+            await srv_a.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_hedged_request_races_a_second_backend():
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    async def run():
+        async def mk(tag, delay):
+            async def ready(request):
+                return web.json_response({"ready": True})
+
+            async def predict(request):
+                await asyncio.sleep(delay)
+                return web.json_response({"predictions": [tag]})
+
+            app = web.Application()
+            app.router.add_get("/v2/health/ready", ready)
+            app.router.add_post("/v1/models/m:predict", predict)
+            srv = TestServer(app)
+            await srv.start_server()
+            return srv, f"http://127.0.0.1:{srv.port}"
+
+        # insertion order makes the SLOW backend the first pick (rotation
+        # counter starts at 0) — exactly the case hedging exists for
+        srv_slow, url_slow = await mk("slow", 0.6)
+        srv_fast, url_fast = await mk("fast", 0.0)
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0,
+            routes=[ServiceRoute(name="m", hedge_ms=40.0)],
+            backends=[("m", url_slow, "default"), ("m", url_fast, "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            h0 = _metric("kft_gateway_hedges_total", service="m")
+            t0 = time.monotonic()
+            r = await client.post("/v1/models/m:predict",
+                                  json={"instances": [[1]]})
+            assert r.status == 200
+            assert (await r.json())["predictions"] == ["fast"]
+            assert time.monotonic() - t0 < 0.5  # did not wait out the slow one
+            assert _metric("kft_gateway_hedges_total", service="m") == h0 + 1
+        finally:
+            await client.close()
+            await srv_slow.close()
+            await srv_fast.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_kill_backend_injector_and_wedge_resume():
+    import signal
+    import subprocess
+    import sys
+
+    from kubeflow_tpu.chaos.injectors import kill_backend, resume_backend
+
+    k0 = _metric("kft_chaos_injected_total", kind="backend_kill")
+    w0 = _metric("kft_chaos_injected_total", kind="backend_wedge")
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    try:
+        kill_backend(proc.pid, wedge=True)
+        assert _metric("kft_chaos_injected_total", kind="backend_wedge") == w0 + 1
+        resume_backend(proc.pid)
+        kill_backend(proc.pid)
+        assert proc.wait(timeout=10) == -signal.SIGKILL
+        assert _metric("kft_chaos_injected_total", kind="backend_kill") == k0 + 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_gateway_sse_passthrough_error_frame_on_midstream_death():
+    """A backend that dies mid-SSE must surface a clean terminal error
+    frame to the client, not a torn socket."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    async def run():
+        async def ready(request):
+            return web.json_response({"ready": True})
+
+        async def stream(request):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            await resp.write(b'data: {"token_ids": [1]}\n\n')
+            await resp.drain()
+            request.transport.close()  # the process "died" mid-stream
+            return resp
+
+        app = web.Application()
+        app.router.add_get("/v2/health/ready", ready)
+        app.router.add_post("/v2/models/m/generate_stream", stream)
+        srv = TestServer(app)
+        await srv.start_server()
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0,
+            backends=[("m", f"http://127.0.0.1:{srv.port}", "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            r = await client.post("/v2/models/m/generate_stream",
+                                  json={"prompt": "x"})
+            assert r.status == 200
+            text = (await r.read()).decode()
+            frames = [json.loads(line[6:]) for line in text.splitlines()
+                      if line.startswith("data: ")]
+            assert frames[0] == {"token_ids": [1]}
+            assert "error" in frames[-1]  # clean terminal frame
+        finally:
+            await client.close()
+            await srv.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_sse_client_disconnect_cancels_backend_row():
+    """The acceptance: a client dropping its SSE connection propagates
+    through the gateway to the backend, which cancels the engine row
+    (observed here as the stream generator being closed)."""
+
+    class _FakeStreamModel(Model):
+        def __init__(self):
+            super().__init__("lm")
+            self.ready = True
+            self.closed = False
+
+        def preprocess(self, payload, headers=None):
+            return list(payload["instances"])
+
+        def stream_row_tokens(self, row):
+            model = self
+
+            def gen():
+                try:
+                    for i in range(10_000):
+                        yield [i]
+                        time.sleep(0.005)
+                finally:
+                    model.closed = True  # row cancelled / stream done
+
+            return gen()
+
+    async def run():
+        from aiohttp.test_utils import TestServer
+
+        model = _FakeStreamModel()
+        ms = ModelServer([model])
+        srv = TestServer(ms.build_app())
+        await srv.start_server()
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0,
+            backends=[("lm", f"http://127.0.0.1:{srv.port}", "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            resp = await client.post("/v2/models/lm/generate_stream",
+                                     json={"ids": [1, 2]})
+            assert resp.status == 200
+            assert (await resp.content.readline()).startswith(b"data: ")
+            resp.close()  # client walks away mid-stream
+            deadline = time.monotonic() + 5.0
+            while not model.closed and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            assert model.closed, "backend engine row was not cancelled"
+        finally:
+            await client.close()
+            await srv.close()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------- ModelServer drain + signals
+
+
+def test_model_server_graceful_drain_completes_inflight():
+    class _Slow(Model):
+        def __init__(self):
+            super().__init__("slow")
+            self.ready = True
+
+        async def __call__(self, payload, headers=None):
+            await asyncio.sleep(0.25)
+            return {"predictions": ["done"]}
+
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        ms = ModelServer([_Slow()], drain_grace_s=5.0)
+        async with TestClient(TestServer(ms.build_app())) as client:
+            r = await client.get("/v2/health/ready")
+            assert r.status == 200
+            req = asyncio.ensure_future(
+                client.post("/v1/models/slow:predict",
+                            json={"instances": [[1]]})
+            )
+            await asyncio.sleep(0.05)
+            assert ms.dataplane.total_inflight() == 1
+            stop = asyncio.ensure_future(ms.stop_async())
+            await asyncio.sleep(0.05)
+            # readiness flipped to 503 FIRST, while the request still runs
+            r = await client.get("/v2/health/ready")
+            assert r.status == 503
+            assert (await r.json())["draining"] is True
+            await stop
+            # the drain outlived the in-flight request: nothing dropped
+            assert req.done()
+            resp = await req
+            assert resp.status == 200
+            assert (await resp.json())["predictions"] == ["done"]
+            assert ms.dataplane.total_inflight() == 0
+
+    asyncio.run(run())
+
+
+def test_model_server_drain_grace_is_bounded():
+    class _Stuck(Model):
+        def __init__(self):
+            super().__init__("stuck")
+            self.ready = True
+
+        async def __call__(self, payload, headers=None):
+            await asyncio.sleep(60)
+            return {}
+
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        ms = ModelServer([_Stuck()], drain_grace_s=0.1)
+        async with TestClient(TestServer(ms.build_app())) as client:
+            req = asyncio.ensure_future(
+                client.post("/v1/models/stuck:predict",
+                            json={"instances": [[1]]})
+            )
+            await asyncio.sleep(0.05)
+            t0 = time.monotonic()
+            await ms.stop_async()
+            assert time.monotonic() - t0 < 2.0  # bounded, not forever
+            req.cancel()
+
+    asyncio.run(run())
+
+
+def test_model_server_exports_inflight_and_queue_depth():
+    from kubeflow_tpu.serve.batcher import BatcherConfig
+
+    class _Slow(Model):
+        def __init__(self):
+            super().__init__("slow")
+            self.ready = True
+
+        async def __call__(self, payload, headers=None):
+            await asyncio.sleep(0.2)
+            return {"predictions": [1]}
+
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        ms = ModelServer([_Slow()])
+        # a second, batched model so the queue-depth line is present
+        ms.dataplane.register(EchoModel("batched"),
+                              BatcherConfig(max_batch_size=4))
+        ms.dataplane.get("batched").ready = True
+        async with TestClient(TestServer(ms.build_app())) as client:
+            req = asyncio.ensure_future(
+                client.post("/v1/models/slow:predict",
+                            json={"instances": [[1]]})
+            )
+            await asyncio.sleep(0.05)
+            text = await (await client.get("/metrics")).text()
+            assert 'kft_server_inflight{model="slow"} 1' in text
+            assert 'kft_server_queue_depth{model="batched"} 0' in text
+            await req
+            text = await (await client.get("/metrics")).text()
+            assert 'kft_server_inflight{model="slow"} 0' in text
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------- config + dashboard
+
+
+def test_gateway_config_from_manifest_and_cli_rejects_garbage(tmp_path):
+    doc = {
+        "kind": "InferenceGateway",
+        "metadata": {"name": "edge"},
+        "spec": {
+            "salt": "s1",
+            "failureThreshold": 2,
+            "queueLimit": 7,
+            "services": [{
+                "name": "lm",
+                "hosts": ["lm.default"],
+                "canaryPercent": 25,
+                "affinity": "prefix",
+                "hedgeMs": 15,
+                "backends": [
+                    "http://127.0.0.1:9001",
+                    {"url": "http://127.0.0.1:9002", "revision": "canary"},
+                ],
+            }],
+            "policy": {"tenants": {"team-a": {"maxRps": 5, "burst": 10,
+                                              "maxInFlight": 3}}},
+        },
+    }
+    cfg = GatewayConfig.from_manifest(doc)
+    assert cfg.name == "edge" and cfg.salt == "s1"
+    assert cfg.failure_threshold == 2 and cfg.queue_limit == 7
+    (route,) = cfg.routes
+    assert route.affinity == "prefix" and route.hedge_ms == 15.0
+    assert cfg.backends == [
+        ("lm", "http://127.0.0.1:9001", "default"),
+        ("lm", "http://127.0.0.1:9002", "canary"),
+    ]
+    assert cfg.tenants["team-a"]["max_in_flight"] == 3
+    with pytest.raises(ValueError):
+        GatewayConfig.from_manifest({"kind": "Deployment"})
+
+    # kft gateway run rejects files without an InferenceGateway manifest
+    from kubeflow_tpu.cli import main as cli_main
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("kind: ConfigMap\nmetadata: {name: x}\n")
+    assert cli_main(["gateway", "run", "-f", str(bad)]) == 2
+
+
+def test_dashboard_gateway_tab_api():
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubeflow_tpu.platform.dashboard import DashboardServer
+
+        gw = InferenceGateway(GatewayConfig(
+            routes=[ServiceRoute(name="m", canary_percent=10.0)],
+            backends=[("m", "http://127.0.0.1:1", "default")],
+        ))
+        dash = DashboardServer(cluster=None, gateway=gw)
+        async with TestClient(TestServer(dash._make_app())) as client:
+            body = await (await client.get("/api/gateway")).json()
+            (svc,) = body["services"]
+            assert svc["name"] == "m" and svc["canary_percent"] == 10.0
+            assert svc["backends"][0]["url"] == "http://127.0.0.1:1"
+        # no gateway attached → empty view, tab renders "none"
+        assert DashboardServer(cluster=None).gateway_view() == {}
+
+    asyncio.run(run())
