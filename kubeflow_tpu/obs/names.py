@@ -123,3 +123,16 @@ ENGINE_D2H_DRAIN_MS = "kft_engine_d2h_drain_ms"
 ENGINE_CARRY_UPLOADS_TOTAL = "kft_engine_carry_uploads_total"
 #: EWMA occupied-row fraction at chunk dispatch
 ENGINE_SLOT_OCCUPANCY = "kft_engine_slot_occupancy"
+#: prefix-cache effectiveness (the signal the gateway's prefix affinity
+#: steers by): cumulative hits / KV tokens reused, live entry count and
+#: stored-token occupancy
+ENGINE_PREFIX_HITS_TOTAL = "kft_engine_prefix_hits_total"
+ENGINE_PREFIX_TOKENS_REUSED_TOTAL = "kft_engine_prefix_tokens_reused_total"
+ENGINE_PREFIX_ENTRIES = "kft_engine_prefix_entries"
+ENGINE_PREFIX_TOKENS_STORED = "kft_engine_prefix_tokens_stored"
+#: speculative decoding (serve/speculative.py): draft tokens proposed /
+#: accepted by the in-graph verify, and the EWMA acceptance ratio — the
+#: tokens-per-forward multiplier prompt-lookup is buying
+ENGINE_SPEC_PROPOSED_TOTAL = "kft_engine_spec_proposed_total"
+ENGINE_SPEC_ACCEPTED_TOTAL = "kft_engine_spec_accepted_total"
+ENGINE_SPEC_ACCEPTANCE = "kft_engine_spec_acceptance"

@@ -65,6 +65,7 @@ from kubeflow_tpu.models.transformer import (
 from kubeflow_tpu.serve.generate import (
     LMRuntimeModel,
     decode_kv_mask,
+    decode_span_kv_mask,
     sample_logits as _sample,
 )
 
@@ -84,7 +85,19 @@ class LMEngineConfig:
     fully-synchronous inline loop (per-chunk H2D/D2H) for parity testing
     and debugging. Depths > 1 are rejected: a second speculative chunk
     would decode on a carry the host can no longer merge-edit cheaply,
-    for no additional overlap (one chunk already hides the drain)."""
+    for no additional overlap (one chunk already hides the drain).
+
+    ``spec_draft_tokens`` (K): in-graph speculative decoding
+    (serve/speculative.py) — each decode step drafts up to K tokens by
+    prompt-lookup against the row's own device-resident token history
+    and verifies them in ONE (K+1)-position forward, emitting up to K+1
+    tokens per forward. 0 (default) disables it: the classic one-token
+    step program runs, byte-compatible with the pre-spec engine. Greedy
+    decoding is byte-identical either way; K only changes how many
+    forwards the same token stream costs. ``spec_ngram``: the match
+    window the drafter keys on (>= 1). Dense mode reserves K scratch
+    slots of KV headroom per row, so admission requires
+    ``layout + max_new_tokens + K <= max_seq`` when spec is on."""
 
     max_batch: int = 8
     max_seq: int = 256
@@ -102,6 +115,8 @@ class LMEngineConfig:
     kv_pool_tokens: int | None = None
     page_size: int = 64
     pipeline_depth: int = 1
+    spec_draft_tokens: int = 0
+    spec_ngram: int = 3
 
 
 @dataclass
@@ -111,13 +126,17 @@ class _PendingChunk:
     out speculative results of rows retired while the chunk was in
     flight (cancellation, re-admission)."""
 
-    toks: Any          # (B, T) device tokens
-    valid: Any         # (B, T) device validity
+    toks: Any          # (B, T) device tokens — (B, T, K+1) planes w/ spec
+    valid: Any         # (B, T) device validity — (B, T, K+1) w/ spec
     last_tok: Any      # (B,) post-chunk carry token
     gen_count: Any     # (B,) post-chunk generation counts
     active_out: Any    # (B,) post-chunk liveness
     active_in: Any     # (B,) liveness AT DISPATCH (drain credit gate)
     slots: list        # _Request-per-row snapshot at dispatch
+    # speculative decoding extras (None when spec_draft_tokens == 0):
+    eos: Any = None    # (B, T) a live EOS landed in this step's span
+    prop: Any = None   # (B, T) draft tokens proposed (live rows)
+    acc: Any = None    # (B, T) draft tokens accepted (live rows)
 
 
 @dataclass
@@ -193,6 +212,19 @@ class LMEngine:
                 f"got {config.pipeline_depth}"
             )
         self.pipeline_depth = config.pipeline_depth
+        if config.spec_draft_tokens < 0:
+            raise ValueError(
+                f"spec_draft_tokens must be >= 0 (0 disables speculative "
+                f"decoding); got {config.spec_draft_tokens}"
+            )
+        if config.spec_draft_tokens and config.spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1 when speculative decoding is on; "
+                f"got {config.spec_ngram}"
+            )
+        #: speculative decode: K draft tokens verified per forward (0=off)
+        self.spec_k = config.spec_draft_tokens
+        self.spec_ngram = config.spec_ngram
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
         from kubeflow_tpu.core.compcache import enable_compilation_cache
@@ -303,6 +335,18 @@ class LMEngine:
         self.active = np.zeros((max_batch,), bool)
         self.temp = np.zeros((max_batch,), np.float32)
         self._slots: list[_Request | None] = [None] * max_batch
+        # speculative decoding: the host mirror of the per-row token
+        # history (prompt + generated, TOKEN-POSITION indexed — identical
+        # for dense and paged layouts). The device copy rides the carry
+        # and is rewritten in-graph each decode step; this mirror (fed at
+        # admission and from drained tokens) rebuilds it on every epoch
+        # re-upload. Width max_seq + K + 1 gives the in-graph span write
+        # (K+1 wide at index hist_len) guaranteed headroom — no clamping.
+        self.hist_host = (
+            np.zeros((max_batch, max_seq + self.spec_k + 1), np.int32)
+            if self.spec_k
+            else None
+        )
 
         self._pending: queue.Queue[_Request] = queue.Queue()
         self._fatal: Exception | None = None
@@ -314,6 +358,9 @@ class LMEngine:
             "admitted": 0, "completed": 0, "chunks": 0,
             "max_concurrent": 0, "prefix_hits": 0, "prefix_tokens_reused": 0,
             "prefill_pieces": 0, "idle_wakes": 0,
+            # speculative decoding: drafts proposed/accepted (the tokens-
+            # per-forward multiplier — kft_engine_spec_*_total)
+            "spec_proposed": 0, "spec_accepted": 0,
         }
         # pipelined-decode state: the device-resident carry of per-row
         # scheduling arrays, its dirtiness (host edits pending merge), and
@@ -331,6 +378,7 @@ class LMEngine:
             "d2h_drain_ms": 0.0,    # EWMA token-drain D2H sync time
             "carry_uploads": 0,     # epoch re-uploads (~admissions, not chunks)
             "slot_occupancy": 0.0,  # EWMA occupied-row fraction at dispatch
+            "spec_acceptance": 0.0,  # EWMA accepted/proposed draft ratio
         }
         if self.paged:
             # pre-initialized: /metrics iterates this dict from another
@@ -350,6 +398,10 @@ class LMEngine:
         self._prefix_cache_entries = prefix_cache_entries
         self._prefix_cache_tokens = prefix_cache_tokens
         self._prefix_lens: dict[int, int] = {}  # stored length → count
+        #: descending stored lengths, memoized — _lookup_prefix runs on
+        #: every admission, so it must not pay an O(L log L) sort per
+        #: request; store/evict invalidate (None → rebuild on next probe)
+        self._prefix_lens_sorted: list[int] | None = None
         self._prefix_tokens_stored = 0
 
         # ONE prefill program: a full prefill IS a suffix prefill at
@@ -361,12 +413,19 @@ class LMEngine:
         # the result. (A failed donated call kills the buffers; the
         # scheduler's fatal path already fails all requests and the
         # engine is rebuilt on reload.)
+        # the spec chunk programs donate the history buffer alongside the
+        # cache: both are engine-owned device state rebound to the call's
+        # result every chunk (never Orbax-restored), so donation is safe
+        # and saves a (B, max_seq) copy per chunk
+        chunk_donate = (0, 1) if self.spec_k else (0,)
         if self.paged:
             self._suffix_prefill = jax.jit(
                 self._suffix_prefill_paged_impl, donate_argnums=(0,)
             )
             self._chunk = jax.jit(
-                self._chunk_paged_impl, donate_argnums=(0,)
+                self._chunk_spec_paged_impl if self.spec_k
+                else self._chunk_paged_impl,
+                donate_argnums=chunk_donate,
             )
             self._implant_jits: dict[int, Any] = {}
             #: a request held back by page backpressure (FIFO preserved:
@@ -377,7 +436,10 @@ class LMEngine:
                 self._suffix_prefill_impl, donate_argnums=(0,)
             )
             self._implant = jax.jit(self._implant_impl, donate_argnums=(0,))
-            self._chunk = jax.jit(self._chunk_impl, donate_argnums=(0,))
+            self._chunk = jax.jit(
+                self._chunk_spec_impl if self.spec_k else self._chunk_impl,
+                donate_argnums=chunk_donate,
+            )
         self._extract_jits: dict[int, Any] = {}
 
     # -- device programs ---------------------------------------------------- #
@@ -472,7 +534,7 @@ class LMEngine:
 
             fn = self._extract_jits[n16] = jax.jit(impl)
         if self.paged:
-            return fn(self.cache, jnp.asarray(self.pager.table[row]))
+            return fn(self.cache, jnp.asarray(self.pager.table[row].copy()))
         return fn(self.cache, row)
 
     def _chunk_impl(
@@ -521,6 +583,204 @@ class LMEngine:
             length=self.chunk_steps,
         )
         return cache, tok, gen_count, active, toks.T, valid.T  # (B, T)
+
+    # -- speculative decoding (serve/speculative.py) ------------------------- #
+
+    def _spec_emit(
+        self, emitted, n_emit, draft_len, n_acc, tok, gen_count, active,
+        budget,
+    ):
+        """Shared post-verify gating for one speculative decode step:
+        apply the liveness/budget/EOS rules of the classic one-token step
+        to the whole emitted span. Position i of the span is *live* iff
+        the row was live entering the step, the position was actually
+        emitted (i < n_emit), budget admits it (gen_count + i < budget),
+        and no live EOS landed earlier in the span; live positions
+        consume budget exactly like single-token steps, EOS positions are
+        live-but-invalid (budget charged, token not emitted — today's
+        semantics), and everything after a live EOS is dead."""
+        K1 = self.spec_k + 1
+        i = jnp.arange(K1)[None, :]
+        live0 = active & (gen_count < budget)
+        cand = (
+            live0[:, None]
+            & (i < n_emit[:, None])
+            & (gen_count[:, None] + i < budget[:, None])
+        )
+        is_eos = emitted == self.eos_id
+        eos_here = (cand & is_eos).astype(jnp.int32)
+        no_eos_before = jnp.concatenate(
+            [
+                jnp.ones_like(eos_here[:, :1]),
+                jnp.cumprod(1 - eos_here, axis=1)[:, :-1],
+            ],
+            axis=1,
+        ).astype(bool)
+        live_i = cand & no_eos_before                       # (B, K+1)
+        valid_i = live_i & ~is_eos
+        out = jnp.where(valid_i, emitted, self.pad_id)
+        adv = live_i.sum(axis=1).astype(gen_count.dtype)    # (B,)
+        eos_step = (live_i & is_eos).any(axis=1)
+        # carry token: the last VALID emitted token (frozen through EOS /
+        # dead steps, exactly like the one-token step's jnp.where chain)
+        last_idx = jnp.clip(adv - 1, 0, K1 - 1)
+        last_out = jnp.take_along_axis(out, last_idx[:, None], axis=1)[:, 0]
+        last_ok = jnp.take_along_axis(
+            valid_i, last_idx[:, None], axis=1
+        )[:, 0]
+        new_tok = jnp.where((adv > 0) & last_ok, last_out, tok)
+        new_gen = gen_count + adv
+        new_active = active & ~eos_step
+        # telemetry planes, gated to live rows so post-retirement SPMD
+        # steps don't inflate the acceptance gauges
+        prop = jnp.where(live0, draft_len, 0)
+        acc = jnp.where(live0, jnp.minimum(n_acc, adv), 0)
+        return (
+            out, valid_i, live_i, eos_step, new_tok, new_gen, new_active,
+            prop, acc,
+        )
+
+    def _spec_hist_update(self, hist, hist_len, emitted, live_i):
+        """Scatter the span's live emitted tokens into each row's history
+        at positions [hist_len, hist_len + K]. hist is max_seq + K + 1
+        wide, so the window never clamps (a clamped start would shift the
+        write over real history)."""
+        K1 = self.spec_k + 1
+
+        def upd(hrow, start, vals, mask):
+            win = jax.lax.dynamic_slice(hrow, (start,), (K1,))
+            return jax.lax.dynamic_update_slice(
+                hrow, jnp.where(mask, vals, win), (start,)
+            )
+
+        return jax.vmap(upd)(hist, hist_len, emitted, live_i)
+
+    def _chunk_spec_impl(
+        self, cache, hist, last_tok, real_len, gen_start, gen_count,
+        active, budget, temperature, rng,
+    ):
+        """Speculative twin of _chunk_impl: each scan step drafts up to K
+        tokens by prompt-lookup against the row's device-resident history
+        and verifies them in ONE (K+1)-position forward (per-position
+        logits + in-span causal masking via decode_span_kv_mask — the
+        suffix-prefill machinery's mask, lifted per query). Accepted
+        drafts' KV is already correct (they were the forward's inputs);
+        rejected positions' KV lands beyond the accepted pointer where
+        later steps re-overwrite it before it is ever attended — the same
+        frozen-slot trick dead rows use. Rows with no match draft length
+        0 and degrade to the classic one-token step."""
+        from kubeflow_tpu.serve.speculative import propose_draft, spec_accept
+
+        K = self.spec_k
+        kpos = jnp.arange(self.max_seq)
+
+        def step(carry, _):
+            cache, hist, tok, gen_count, active, rng = carry
+            rng, sub = jax.random.split(rng)
+            L = real_len + gen_count                  # (B,) history length
+            draft, draft_len = propose_draft(
+                hist, L, ngram=self.spec_ngram, k=K
+            )
+            # x_0 is the carry token (its KV is written now, at its slot,
+            # exactly as the one-token step does); x_{i+1} = draft i
+            x = jnp.concatenate([tok[:, None], draft], axis=1)
+            slot0 = gen_start + gen_count - 1
+            positions = (L - 1)[:, None] + jnp.arange(K + 1)[None, :]
+            kv_mask = decode_span_kv_mask(
+                kpos, real_len, gen_start, slot0, K + 1,
+                self.cfg.attn_window,
+            )
+            lg, cache = self.model.apply(
+                {"params": self.params}, x, cache=cache, cache_index=slot0,
+                positions=positions, kv_mask=kv_mask,
+            )
+            emitted, n_emit, n_acc = spec_accept(
+                lg, draft, draft_len, sub, temperature
+            )
+            (
+                out, valid_i, live_i, eos_step, tok, gen_count, active,
+                prop, acc,
+            ) = self._spec_emit(
+                emitted, n_emit, draft_len, n_acc, tok, gen_count, active,
+                budget,
+            )
+            hist = self._spec_hist_update(hist, L, emitted, live_i)
+            return (cache, hist, tok, gen_count, active, rng), (
+                out, valid_i, eos_step, prop, acc,
+            )
+
+        (cache, hist, tok, gen_count, active, _), outs = jax.lax.scan(
+            step,
+            (cache, hist, last_tok, gen_count, active, rng),
+            None,
+            length=self.chunk_steps,
+        )
+        toks, valid, eos, prop, acc = outs
+        return (
+            cache, hist, tok, gen_count, active,
+            jnp.moveaxis(toks, 0, 1), jnp.moveaxis(valid, 0, 1),  # (B,T,K+1)
+            eos.T, prop.T, acc.T,                                 # (B, T)
+        )
+
+    def _chunk_spec_paged_impl(
+        self, cache, hist, last_tok, real_len, gen_count, active, budget,
+        temperature, rng, table,
+    ):
+        """Paged twin of _chunk_spec_impl: the (K+1)-position verify runs
+        through the block table with positions (L-1 .. L-1+K) per row —
+        masking is position arithmetic, already per query. Span positions
+        past the row's budgeted region route to the scratch page (their
+        page ordinal may sit past the read window, where a clamped gather
+        would otherwise redirect the write INTO the row's real pages)."""
+        from kubeflow_tpu.serve.speculative import propose_draft, spec_accept
+
+        K = self.spec_k
+
+        def step(carry, _):
+            cache, hist, tok, gen_count, active, rng = carry
+            rng, sub = jax.random.split(rng)
+            live0 = active & (gen_count < budget)
+            L = real_len + gen_count
+            draft, draft_len = propose_draft(
+                hist, L, ngram=self.spec_ngram, k=K
+            )
+            x = jnp.concatenate([tok[:, None], draft], axis=1)
+            positions = (L - 1)[:, None] + jnp.arange(K + 1)[None, :]
+            write_ok = live0[:, None] & (
+                positions < (real_len + budget)[:, None]
+            )
+            lg, cache = self.model.apply(
+                {"params": self.params}, x, cache=cache,
+                positions=positions, page_table=table,
+                page_size=self.page_size, page_write_ok=write_ok,
+            )
+            emitted, n_emit, n_acc = spec_accept(
+                lg, draft, draft_len, sub, temperature
+            )
+            (
+                out, valid_i, live_i, eos_step, tok, gen_count, active,
+                prop, acc,
+            ) = self._spec_emit(
+                emitted, n_emit, draft_len, n_acc, tok, gen_count, active,
+                budget,
+            )
+            hist = self._spec_hist_update(hist, L, emitted, live_i)
+            return (cache, hist, tok, gen_count, active, rng), (
+                out, valid_i, eos_step, prop, acc,
+            )
+
+        (cache, hist, tok, gen_count, active, _), outs = jax.lax.scan(
+            step,
+            (cache, hist, last_tok, gen_count, active, rng),
+            None,
+            length=self.chunk_steps,
+        )
+        toks, valid, eos, prop, acc = outs
+        return (
+            cache, hist, tok, gen_count, active,
+            jnp.moveaxis(toks, 0, 1), jnp.moveaxis(valid, 0, 1),
+            eos.T, prop.T, acc.T,
+        )
 
     # -- paged device programs (serve/paging.py block-table mode) ----------- #
 
@@ -585,7 +845,7 @@ class LMEngine:
                 impl, donate_argnums=(0,)
             )
         self.cache = fn(
-            self.cache, stored, jnp.asarray(self.pager.table[row])
+            self.cache, stored, jnp.asarray(self.pager.table[row].copy())
         )
 
     def _chunk_paged_impl(
@@ -706,6 +966,20 @@ class LMEngine:
             raise ValueError(
                 f"prompt layout {layout} + max_new_tokens {max_new_tokens} "
                 f"exceeds engine max_seq {self.max_seq}"
+            )
+        if self.spec_k and not self.paged and (
+            layout + max_new_tokens + self.spec_k > self.max_seq
+        ):
+            # dense speculative decode writes rejected-draft KV up to K
+            # slots past the row's budgeted region (re-overwritten, never
+            # attended) — the row must physically hold that headroom.
+            # Paged mode needs none: overflow writes route to the scratch
+            # page.
+            raise ValueError(
+                f"prompt layout {layout} + max_new_tokens {max_new_tokens} "
+                f"+ spec_draft_tokens {self.spec_k} exceeds engine "
+                f"max_seq {self.max_seq} (speculative decode reserves K "
+                f"scratch slots per row)"
             )
         if self.paged:
             need = self.pager.pages_for(len(ids) + max_new_tokens)
@@ -834,9 +1108,13 @@ class LMEngine:
         if self._prefix_cache is None:
             return None
         top = (len(ids) - 1) // 16 * 16
+        if self._prefix_lens_sorted is None:
+            # memoized: store/evict invalidate, so the hot admission path
+            # pays the O(L log L) sort only after the length SET changes
+            self._prefix_lens_sorted = sorted(self._prefix_lens, reverse=True)
         # probe only lengths ACTUALLY stored (descending): a long-prompt
         # miss costs len(stored-lengths) tuple builds, not len(prompt)/16
-        for n16 in sorted(self._prefix_lens, reverse=True):
+        for n16 in self._prefix_lens_sorted:
             if n16 > top:
                 continue
             key = tuple(ids[:n16])
@@ -861,6 +1139,8 @@ class LMEngine:
             self._prefix_cache.move_to_end(key)
             return
         self._prefix_cache[key] = self._extract_prefix(row, n16)
+        if n16 not in self._prefix_lens:
+            self._prefix_lens_sorted = None  # length set changed
         self._prefix_lens[n16] = self._prefix_lens.get(n16, 0) + 1
         self._prefix_tokens_stored += n16
         # evict LRU until within BOTH bounds: entry count and (when set)
@@ -877,6 +1157,7 @@ class LMEngine:
             self._prefix_lens[n] -= 1
             if not self._prefix_lens[n]:
                 del self._prefix_lens[n]
+                self._prefix_lens_sorted = None  # length set changed
 
     def _admit(self, req: _Request, row: int) -> None:
         """Claim a row: implant any cached prefix, lay out the prefill
@@ -900,7 +1181,8 @@ class LMEngine:
             # piece padding routes to the scratch page. Dense rows must
             # fit the padded layout.
             if self.paged or (
-                n16 + n_pieces * C + req.max_new_tokens <= self.max_seq
+                n16 + n_pieces * C + req.max_new_tokens + self.spec_k
+                <= self.max_seq
             ):
                 implanted = (n16, stored, suffix_ids, C, n_pieces)
         if self.paged:
@@ -929,6 +1211,11 @@ class LMEngine:
         req.row, req.gen_start = row, gen_start
         self._slots[row] = req
         self.real_len[row] = len(req.ids)
+        if self.spec_k:
+            # history mirror: the prompt is host data — seeding it here
+            # costs nothing and the next carry upload ships it
+            self.hist_host[row, :] = self.pad_id
+            self.hist_host[row, : len(req.ids)] = req.ids
         self.gen_start[row] = gen_start
         self.gen_count[row] = 0
         self.budget[row] = req.max_new_tokens
@@ -972,7 +1259,7 @@ class LMEngine:
                 jnp.asarray(piece),
                 jnp.asarray([len(piece_ids)], np.int32),
                 base + i * C,
-                jnp.asarray(self.pager.table[row : row + 1, :pages_w]),
+                jnp.asarray(self.pager.table[row : row + 1, :pages_w].copy()),
                 jnp.float32(req.temperature),
                 sub,
             )
@@ -996,6 +1283,8 @@ class LMEngine:
         tok = int(tok)
         if bool(valid):
             req.push([tok])
+            if self.spec_k:
+                self.hist_host[row, len(req.ids)] = tok
         self.last_tok[row] = tok
         # one-token completions (eos first, or budget 1) finish here
         finished = (not bool(valid)) or req.max_new_tokens <= 1
@@ -1125,16 +1414,22 @@ class LMEngine:
 
     # -- pipelined decode: carry upload / dispatch / drain ------------------- #
 
+    @property
+    def _chunk_span(self) -> int:
+        """Max tokens one chunk can advance a row: chunk_steps classic
+        steps, times up-to-(K+1) emitted per step under speculation."""
+        return self.chunk_steps * (self.spec_k + 1)
+
     def _all_may_retire(self) -> bool:
         """True when every host-visible active row could exhaust its token
         budget within ONE more chunk. The host mirrors lag the in-flight
-        chunk by exactly chunk_steps, so remaining ≤ chunk_steps means the
+        chunk by at most one chunk's span, so remaining ≤ span means the
         undrained chunk may already retire the whole batch."""
         act = self.active
         if not act.any():
             return True
         remaining = (self.budget - self.gen_count)[act]
-        return bool((remaining <= self.chunk_steps).all())
+        return bool((remaining <= self._chunk_span).all())
 
     def _ewma(self, key: str, value: float, alpha: float = 0.2) -> None:
         cur = self.overlap[key]
@@ -1145,15 +1440,28 @@ class LMEngine:
     def _upload_carry(self) -> None:
         """Upload the per-row scheduling arrays from the host mirrors —
         the ONE H2D an epoch pays. Must only run with the mirrors current
-        (no undrained chunk): the pipelined loop drains before editing."""
+        (no undrained chunk): the pipelined loop drains before editing.
+
+        Every mirror is ``.copy()``-snapshotted first: on the CPU backend
+        ``jnp.asarray`` of an aligned numpy buffer is ZERO-COPY, so the
+        "device" carry would alias the live mirrors and later in-place
+        host edits (prefill activation, drain refresh) would retroactively
+        rewrite what an in-flight chunk reads — an interleaving-dependent
+        wrong-token/lost-row race (observed as chunked-prefill rows
+        truncating to their first token under churn)."""
         c: dict[str, Any] = {
-            "last_tok": jnp.asarray(self.last_tok),
-            "gen_count": jnp.asarray(self.gen_count),
-            "active": jnp.asarray(self.active),
-            "real_len": jnp.asarray(self.real_len),
-            "budget": jnp.asarray(self.budget),
-            "temp": jnp.asarray(self.temp),
+            "last_tok": jnp.asarray(self.last_tok.copy()),
+            "gen_count": jnp.asarray(self.gen_count.copy()),
+            "active": jnp.asarray(self.active.copy()),
+            "real_len": jnp.asarray(self.real_len.copy()),
+            "budget": jnp.asarray(self.budget.copy()),
+            "temp": jnp.asarray(self.temp.copy()),
         }
+        if self.spec_k:
+            # the device history is rewritten in-graph chunk→chunk; an
+            # epoch rebuilds it from the host mirror (current: epochs
+            # always drain first) — one small int32 H2D per epoch
+            c["hist"] = jnp.asarray(self.hist_host.copy())
         if self.paged:
             act = self.active
             if act.any():
@@ -1163,14 +1471,14 @@ class LMEngine:
             else:
                 self._carry_h0 = self._carry_hcap = 0
             w = self._pages_w(
-                max(min(self._carry_h0 + self.chunk_steps,
+                max(min(self._carry_h0 + self._chunk_span,
                         self._carry_hcap), 1)
             )
             # memoized device mirror: unchanged table + same width = no H2D
             c["table"] = self.pager.device_table(w)
             self._carry_pages_w = w
         else:
-            c["gen_start"] = jnp.asarray(self.gen_start)
+            c["gen_start"] = jnp.asarray(self.gen_start.copy())
         self._carry = c
         self._carry_dirty = False
         self._carry_chunks = 0
@@ -1192,14 +1500,16 @@ class LMEngine:
         self._rng, sub = jax.random.split(self._rng)
         c = self._carry
         active_in = c["active"]
+        eos = prop = acc = None
         if self.paged:
             # page-horizon growth across speculative chunks: active rows
-            # advance ≤ chunk_steps per chunk, so this bound covers every
+            # advance ≤ chunk_span tokens per chunk (chunk_steps × up to
+            # K+1 under speculation), so this bound covers every
             # write/read this chunk can reach; when it crosses a pow2 page
             # bucket, widen the device table (the host table is constant
             # within an epoch, so widening mid-flight is safe)
             horizon = min(
-                self._carry_h0 + (self._carry_chunks + 1) * self.chunk_steps,
+                self._carry_h0 + (self._carry_chunks + 1) * self._chunk_span,
                 self._carry_hcap,
             )
             w = self._pages_w(max(horizon, 1))
@@ -1207,11 +1517,30 @@ class LMEngine:
                 c["table"] = self.pager.device_table(w)
                 self._carry_pages_w = w
                 self.overlap["carry_uploads"] += 1
+            if self.spec_k:
+                (
+                    self.cache, c["hist"], tok, gen_count, active,
+                    toks, valid, eos, prop, acc,
+                ) = self._chunk(
+                    self.cache, c["hist"], c["last_tok"], c["real_len"],
+                    c["gen_count"], c["active"], c["budget"], c["temp"],
+                    sub, c["table"],
+                )
+            else:
+                (
+                    self.cache, tok, gen_count, active, toks, valid
+                ) = self._chunk(
+                    self.cache, c["last_tok"], c["real_len"], c["gen_count"],
+                    c["active"], c["budget"], c["temp"], sub, c["table"],
+                )
+        elif self.spec_k:
             (
-                self.cache, tok, gen_count, active, toks, valid
+                self.cache, c["hist"], tok, gen_count, active,
+                toks, valid, eos, prop, acc,
             ) = self._chunk(
-                self.cache, c["last_tok"], c["real_len"], c["gen_count"],
-                c["active"], c["budget"], c["temp"], sub, c["table"],
+                self.cache, c["hist"], c["last_tok"], c["real_len"],
+                c["gen_start"], c["gen_count"], c["active"], c["budget"],
+                c["temp"], sub,
             )
         else:
             (
@@ -1226,7 +1555,7 @@ class LMEngine:
         return _PendingChunk(
             toks=toks, valid=valid, last_tok=tok, gen_count=gen_count,
             active_out=active, active_in=active_in,
-            slots=list(self._slots),
+            slots=list(self._slots), eos=eos, prop=prop, acc=acc,
         )
 
     def _drain_chunk(self, p: _PendingChunk) -> None:
@@ -1246,7 +1575,13 @@ class LMEngine:
             for x in (p.toks, p.valid, p.active_in, p.last_tok,
                       p.gen_count, p.active_out)
         )
+        if self.spec_k:
+            eos_pl, prop_pl, acc_pl = (
+                np.asarray(x)  # kft: noqa[jax-sync] — same sanctioned decode-boundary D2H; tiny (B, steps) planes riding the token drain
+                for x in (p.eos, p.prop, p.acc)
+            )
         self._ewma("d2h_drain_ms", (time.perf_counter() - t0) * 1e3)
+        chunk_prop = chunk_acc = 0
         for row in range(self.max_batch):
             req = p.slots[row]
             if req is None or not act_in[row]:
@@ -1258,13 +1593,41 @@ class LMEngine:
                 continue
             hit_eos = False
             fresh: list[int] = []
-            for j in range(self.chunk_steps):
-                if len(req.tokens) + len(fresh) >= req.max_new_tokens:
-                    break
-                if not valid[row, j]:
-                    hit_eos = True
-                    break
-                fresh.append(int(toks[row, j]))
+            if self.spec_k:
+                # (steps, K+1) planes: each step's valid tokens are a
+                # PREFIX of its span (live positions are a prefix and EOS
+                # can only be the last live one) — a non-valid plane
+                # inside a step means "not emitted", only the eos flag (a
+                # LIVE EOS landed) stops the row. Walked with numpy, not
+                # a python scalar loop: B x steps x (K+1) iterations per
+                # chunk would hand back the very host time the pipeline
+                # exists to hide.
+                v, t, e = valid[row], toks[row], eos_pl[row]
+                hit_eos = bool(e.any())
+                stop_s = (
+                    int(np.argmax(e)) if hit_eos else self.chunk_steps - 1
+                )
+                flat = t[: stop_s + 1][v[: stop_s + 1]]   # prefix-ordered
+                remaining = req.max_new_tokens - len(req.tokens)
+                fresh = [int(x) for x in flat[:remaining]]
+                row_prop = int(prop_pl[row].sum())
+                row_acc = int(acc_pl[row].sum())
+                self.stats["spec_proposed"] += row_prop
+                self.stats["spec_accepted"] += row_acc
+                chunk_prop += row_prop
+                chunk_acc += row_acc
+                # history mirror: drained tokens land at their token
+                # positions so the next epoch re-upload is exact
+                start = int(self.real_len[row]) + len(req.tokens)
+                self.hist_host[row, start : start + len(fresh)] = fresh
+            else:
+                for j in range(self.chunk_steps):
+                    if len(req.tokens) + len(fresh) >= req.max_new_tokens:
+                        break
+                    if not valid[row, j]:
+                        hit_eos = True
+                        break
+                    fresh.append(int(toks[row, j]))
             req.push(fresh)
             # lazy mirror refresh from the drained outputs — the only place
             # host state learns device progress; per-row (not wholesale) so
@@ -1276,6 +1639,22 @@ class LMEngine:
                 # device-visible retirement: the carry already gates this
                 # row in-graph, so no epoch is burned
                 self._finish(row, carry_stale=False)
+        if chunk_prop:
+            # kft_engine_spec_acceptance: EWMA accepted/proposed ratio —
+            # the live signal for whether prompt-lookup pays on this
+            # replica's traffic
+            self._ewma("spec_acceptance", chunk_acc / chunk_prop)
+
+    def prefix_cache_stats(self) -> dict:
+        """Prefix-cache effectiveness counters for /metrics exposition
+        (kft_engine_prefix_*): cumulative hits / tokens reused plus live
+        entry and stored-token occupancy."""
+        return {
+            "hits": self.stats["prefix_hits"],
+            "tokens_reused": self.stats["prefix_tokens_reused"],
+            "entries": len(self._prefix_cache or ()),
+            "tokens_stored": self._prefix_tokens_stored,
+        }
 
 
 class _AdmittedStream:
@@ -1320,7 +1699,8 @@ class LMEngineModel(LMRuntimeModel):
         self, name, storage_path=None, *, max_batch=8, max_seq=None,
         chunk_steps=8, prefix_cache_entries=0, prefix_cache_tokens=None,
         prefill_chunk=None, mesh=None, rules=None,
-        kv_pool_tokens=None, page_size=64, pipeline_depth=1, **kwargs,
+        kv_pool_tokens=None, page_size=64, pipeline_depth=1,
+        spec_draft_tokens=0, spec_ngram=3, **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
@@ -1333,8 +1713,14 @@ class LMEngineModel(LMRuntimeModel):
         self._engine_pool_tokens = kv_pool_tokens
         self._engine_page_size = page_size
         self._engine_pipeline_depth = pipeline_depth
+        self._engine_spec_draft = spec_draft_tokens
+        self._engine_spec_ngram = spec_ngram
+        # dense speculative decode reserves K scratch KV slots per row —
+        # the default max_seq must include them or the largest bucket's
+        # requests would be rejected at enqueue
         self._engine_max_seq = max_seq or (
             self.buckets.seq_lens[-1] + self.max_new_tokens
+            + (spec_draft_tokens if kv_pool_tokens is None else 0)
         )
         self.engine: LMEngine | None = None
         self._executor = None
@@ -1372,6 +1758,8 @@ class LMEngineModel(LMRuntimeModel):
             kv_pool_tokens=self._engine_pool_tokens,
             page_size=self._engine_page_size,
             pipeline_depth=self._engine_pipeline_depth,
+            spec_draft_tokens=self._engine_spec_draft,
+            spec_ngram=self._engine_spec_ngram,
         ).start()
         return True
 
@@ -1385,19 +1773,38 @@ class LMEngineModel(LMRuntimeModel):
         super().unload()
 
     def warmup(self) -> None:
-        """Compile every prefill bucket + the chunk program, and (when
-        prefix caching is on) the implant/extract/suffix-prefill programs —
-        so no real request pays XLA compilation. Distinct token patterns
-        per bucket stop one warmup prompt prefix-hitting another (which
-        would skip the larger bucket's compile), and the warmup entries are
-        cleared so they never occupy real LRU capacity."""
+        """Compile every prefill bucket + the chunk program — which, with
+        ``spec_draft_tokens=K`` on, IS the (K+1)-position speculative
+        verify program (each warmup submit decodes at least one chunk, so
+        the first speculative request never pays a compile mid-traffic) —
+        and (when prefix caching is on) the implant/extract/suffix-prefill
+        programs. Distinct token patterns per bucket stop one warmup
+        prompt prefix-hitting another (which would skip the larger
+        bucket's compile), and the warmup entries are cleared so they
+        never occupy real LRU capacity. Warmup traffic must not pollute
+        production metrics: every counter — including the spec acceptance
+        gauges, which warmup's repeated-token prompts would skew —
+        restarts at zero."""
         eng = self.engine
         vocab = self.config.vocab_size
         for i, s in enumerate(self.buckets.seq_lens):
             eng.submit([2 + i % (vocab - 2)] * s, max_new_tokens=2)
+        if eng.spec_k:
+            # a repeated-pattern prompt guarantees the drafter's match
+            # path (nonzero draft_len) traces through verify at least
+            # once — budget > K so a full accepted span fits (clamped to
+            # the engine's per-row layout bound)
+            s0 = self.buckets.seq_lens[0]
+            cap = eng.max_seq - s0 - (0 if eng.paged else eng.spec_k)
+            if cap >= 2:
+                eng.submit(
+                    ([3, 5, 7] * s0)[:s0],
+                    max_new_tokens=min(eng.spec_k + 2, cap),
+                )
         if eng._prefix_cache is not None:
             eng._prefix_cache.clear()
             eng._prefix_lens.clear()
+            eng._prefix_lens_sorted = None
             eng._prefix_tokens_stored = 0
             n_b = len(self.buckets.seq_lens)
             for j, n16 in enumerate(
@@ -1441,9 +1848,10 @@ class LMEngineModel(LMRuntimeModel):
                     )
             eng._prefix_cache.clear()
             eng._prefix_lens.clear()
+            eng._prefix_lens_sorted = None
             eng._prefix_tokens_stored = 0
         # warmup traffic must not pollute production metrics (/metrics
-        # gauges, hit rates) — counters restart at zero
+        # gauges, hit rates, spec acceptance) — counters restart at zero
         for key in eng.stats:
             eng.stats[key] = 0
         for key in eng.overlap:

@@ -17,7 +17,12 @@ rather than grown on demand per step — admission control then happens in
 one place and a row can never OOM mid-decode; the cost is that a request
 ending early holds its tail pages until completion. Page 0 is a scratch
 page: writes that must go nowhere (pad positions, dead rows still
-stepping in the SPMD batch) are routed there and nothing ever reads it.
+stepping in the SPMD batch, speculative span positions past a row's
+budgeted region) are routed there and nothing ever reads it — which is
+why speculative decoding needs no extra pages: rejected-draft overflow
+simply scratches. The engine's pipelined read-window (``device_table``)
+widens by up to chunk_steps × (spec_draft_tokens + 1) tokens per
+in-flight chunk to cover the span's reach.
 """
 
 from __future__ import annotations
@@ -113,7 +118,11 @@ class PageAllocator:
 
         ver, arr = self._dev.get(width, (-1, None))
         if ver != self.version or arr is None:
-            arr = jnp.asarray(self.table[:, :width])
+            # snapshot, don't view: jnp.asarray of an aligned numpy
+            # buffer is ZERO-COPY on the CPU backend, so the "device"
+            # mirror would alias the live table and a later alloc/free
+            # would rewrite what an in-flight chunk reads
+            arr = jnp.asarray(self.table[:, :width].copy())
             self._dev[width] = (self.version, arr)
             self.device_uploads += 1
         return arr

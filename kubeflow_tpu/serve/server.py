@@ -56,6 +56,69 @@ BATCHER_FAIL_ISOLATIONS = prom.REGISTRY.gauge(
     "co-batched failures re-run per caller (offender isolation)", ("model",),
 )
 
+#: Engine prefix-cache and speculative-decode effectiveness on the shared
+#: registry (the gateway's prefix affinity and any autoscaler read these
+#: from the ObsServer scrape, not just the ModelServer's own /metrics).
+ENGINE_PREFIX_HITS = prom.REGISTRY.gauge(
+    names.ENGINE_PREFIX_HITS_TOTAL,
+    "engine prefix-cache hits (admissions that implanted stored KV)",
+    ("model",),
+)
+ENGINE_PREFIX_TOKENS_REUSED = prom.REGISTRY.gauge(
+    names.ENGINE_PREFIX_TOKENS_REUSED_TOTAL,
+    "prompt KV tokens served from the prefix cache instead of prefilled",
+    ("model",),
+)
+ENGINE_PREFIX_ENTRIES = prom.REGISTRY.gauge(
+    names.ENGINE_PREFIX_ENTRIES, "prefix-cache entries resident", ("model",),
+)
+ENGINE_PREFIX_TOKENS_STORED = prom.REGISTRY.gauge(
+    names.ENGINE_PREFIX_TOKENS_STORED,
+    "KV tokens held by the prefix cache", ("model",),
+)
+ENGINE_SPEC_PROPOSED = prom.REGISTRY.gauge(
+    names.ENGINE_SPEC_PROPOSED_TOTAL,
+    "speculative draft tokens proposed by prompt-lookup", ("model",),
+)
+ENGINE_SPEC_ACCEPTED = prom.REGISTRY.gauge(
+    names.ENGINE_SPEC_ACCEPTED_TOTAL,
+    "speculative draft tokens accepted by the verify forward", ("model",),
+)
+ENGINE_SPEC_ACCEPTANCE = prom.REGISTRY.gauge(
+    names.ENGINE_SPEC_ACCEPTANCE,
+    "EWMA accepted/proposed draft ratio", ("model",),
+)
+
+
+def _engine_collector(name: str, model):
+    """Scrape-time refresh of the engine gauges; resolves the engine
+    lazily so load/unload cycles (ModelMesh) never leave a stale ref."""
+
+    def collect() -> None:
+        eng = getattr(model, "engine", None)
+        if eng is None:
+            return
+        pc = eng.prefix_cache_stats()
+        ENGINE_PREFIX_HITS.labels(model=name).set(pc["hits"])
+        ENGINE_PREFIX_TOKENS_REUSED.labels(model=name).set(
+            pc["tokens_reused"]
+        )
+        ENGINE_PREFIX_ENTRIES.labels(model=name).set(pc["entries"])
+        ENGINE_PREFIX_TOKENS_STORED.labels(model=name).set(
+            pc["tokens_stored"]
+        )
+        ENGINE_SPEC_PROPOSED.labels(model=name).set(
+            eng.stats["spec_proposed"]
+        )
+        ENGINE_SPEC_ACCEPTED.labels(model=name).set(
+            eng.stats["spec_accepted"]
+        )
+        ENGINE_SPEC_ACCEPTANCE.labels(model=name).set(
+            eng.overlap["spec_acceptance"]
+        )
+
+    return collect
+
 
 def _batcher_collector(name: str, batcher: Batcher):
     def collect() -> None:
@@ -105,11 +168,21 @@ class DataPlane:
                 _batcher_collector(model.name, self._batchers[model.name]),
                 key=("batcher", model.name),
             )
+        if hasattr(model, "engine"):
+            # engine-backed LM runtimes: prefix-cache + speculative-decode
+            # gauges on the shared registry (collector resolves the engine
+            # at scrape time — it may not be loaded yet)
+            prom.REGISTRY.add_collector(
+                _engine_collector(model.name, model),
+                key=("engine", model.name),
+            )
 
     def unregister(self, name: str) -> None:
         m = self._models.pop(name, None)
         if m is not None:
             m.unload()
+            if hasattr(m, "engine"):
+                prom.REGISTRY.remove_collector(("engine", name))
         if self._batchers.pop(name, None) is not None:
             prom.REGISTRY.remove_collector(("batcher", name))
 
@@ -549,6 +622,38 @@ class ModelServer:
                     f'{names.ENGINE_SLOT_OCCUPANCY}{{model="{name}"}} '
                     f'{ov["slot_occupancy"]:.3f}'
                 )
+                lines.append(
+                    f'{names.ENGINE_SPEC_ACCEPTANCE}{{model="{name}"}} '
+                    f'{ov["spec_acceptance"]:.3f}'
+                )
+            # speculative-decode counters + prefix-cache effectiveness
+            # (kft_engine_prefix_* — the gateway's prefix affinity reads
+            # these to know whether its steering actually lands hits)
+            lines.append(
+                f'{names.ENGINE_SPEC_PROPOSED_TOTAL}{{model="{name}"}} '
+                f'{eng.stats.get("spec_proposed", 0)}'
+            )
+            lines.append(
+                f'{names.ENGINE_SPEC_ACCEPTED_TOTAL}{{model="{name}"}} '
+                f'{eng.stats.get("spec_accepted", 0)}'
+            )
+            pc = eng.prefix_cache_stats()
+            lines.append(
+                f'{names.ENGINE_PREFIX_HITS_TOTAL}{{model="{name}"}} '
+                f'{pc["hits"]}'
+            )
+            lines.append(
+                f'{names.ENGINE_PREFIX_TOKENS_REUSED_TOTAL}'
+                f'{{model="{name}"}} {pc["tokens_reused"]}'
+            )
+            lines.append(
+                f'{names.ENGINE_PREFIX_ENTRIES}{{model="{name}"}} '
+                f'{pc["entries"]}'
+            )
+            lines.append(
+                f'{names.ENGINE_PREFIX_TOKENS_STORED}{{model="{name}"}} '
+                f'{pc["tokens_stored"]}'
+            )
             pager = getattr(eng, "pager", None)
             if pager is not None:  # paged-KV engines: live pool pressure
                 for key, val in pager.stats().items():

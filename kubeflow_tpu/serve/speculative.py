@@ -1,0 +1,176 @@
+"""In-graph speculative decoding: prompt-lookup drafting + verify math.
+
+Reference analog: Leviathan et al., "Fast Inference from Transformers via
+Speculative Decoding", in its draft-model-free *prompt-lookup* form (the
+vLLM ``[ngram]`` speculator lineage): instead of a second model, the
+drafter matches the last ``ngram`` tokens of a row against the row's OWN
+history (prompt + everything generated so far) and proposes the
+continuation of the most recent match. One (K+1)-position verify forward
+then scores the carry token plus K draft tokens; the longest agreeing
+prefix is emitted together with the bonus token from the first rejected
+position — up to K+1 tokens for ONE forward pass. On memory-bound decode
+(every weight streamed per forward) that multiplies tokens/s by the
+acceptance rate; templated/RAG-style traffic — exactly what the
+gateway's prefix affinity concentrates per replica — accepts hardest.
+
+TPU-first shape: everything here is pure array ops over static shapes so
+it can live INSIDE the engine's jitted decode scan (serve/engine.py) —
+drafting never leaves the device, rows with no match draft length 0 and
+degrade to the classic one-token step (SPMD: every row runs the same
+program; dead draft positions are masked exactly like over-budget rows).
+
+Greedy verification is exact-argmax-prefix acceptance, which makes
+speculative decoding *provably byte-identical* to non-speculative greedy
+decoding (pinned by tests). Temperature > 0 uses the
+distribution-preserving rejection rule: the prompt-lookup proposal is a
+point mass, so draft token d is accepted with probability p(d) and a
+rejection resamples from p with d's mass removed and renormalized —
+the emitted distribution is exactly p either way.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def propose_draft(hist, hist_len, *, ngram: int, k: int):
+    """Per-row prompt-lookup draft from the row's own token history.
+
+    ``hist``: (B, H) int32 token buffer; positions ``[0, hist_len)`` hold
+    the row's prompt followed by its generated tokens (entries at or past
+    ``hist_len`` are stale and never consulted). ``hist_len``: (B,).
+
+    Returns ``(draft, draft_len)``: (B, k) proposed continuation tokens
+    and (B,) how many are real. A row drafts by matching its last
+    ``ngram`` tokens against every earlier window and taking the
+    continuation of the MOST RECENT match; the trivial self-match (the
+    context matching itself at the end of history) is excluded, as is any
+    window without at least one continuation token inside history. Rows
+    without enough history or without a match return draft_len 0.
+    ``ngram`` and ``k`` are static (compiled into the engine's chunk
+    program); the scan is O(B x H x ngram) comparisons — noise next to a
+    forward pass.
+    """
+    B, H = hist.shape
+    pos = jnp.arange(H)
+    # the matching context: the last ngram tokens, ending at hist_len-1
+    # (clipped reads are junk when hist_len < ngram — gated below)
+    cstart = hist_len - ngram                                    # (B,)
+    ctx = jnp.take_along_axis(
+        hist,
+        jnp.clip(cstart[:, None] + jnp.arange(ngram)[None, :], 0, H - 1),
+        axis=1,
+    )                                                            # (B, n)
+    # m[b, p] == True iff hist[b, p:p+ngram] == ctx[b] — built from
+    # ngram shifted views; rolled wrap-around entries are excluded by the
+    # validity bound (p + ngram < hist_len <= H)
+    m = jnp.ones((B, H), bool)
+    for i in range(ngram):
+        m &= jnp.roll(hist, -i, axis=1) == ctx[:, i][:, None]
+    # a candidate window must end strictly before the context's own
+    # occurrence (kills the self-match) AND leave >= 1 continuation token
+    valid = m & (pos[None, :] + ngram < hist_len[:, None])
+    # prefer the most recent match with a FULL k-token continuation: in
+    # periodic history (the traffic this drafter exists for) the most
+    # recent match sits one period from the end and would cap drafts at
+    # period-1 tokens; any earlier repetition yields the same
+    # continuation at full length. Fall back to the most recent match
+    # overall (shorter draft) when no full window exists.
+    full = valid & (pos[None, :] + ngram + k <= hist_len[:, None])
+    p_full = jnp.max(jnp.where(full, pos[None, :], -1), axis=1)   # (B,)
+    p_any = jnp.max(jnp.where(valid, pos[None, :], -1), axis=1)   # (B,)
+    p_star = jnp.where(p_full >= 0, p_full, p_any)
+    has = (p_star >= 0) & (hist_len >= ngram + 1)
+    src = p_star + ngram                                          # (B,)
+    idx = jnp.clip(src[:, None] + jnp.arange(k)[None, :], 0, H - 1)
+    draft = jnp.take_along_axis(hist, idx, axis=1)                # (B, k)
+    avail = jnp.clip(hist_len - src, 0, k)
+    draft_len = jnp.where(has, avail, 0).astype(jnp.int32)
+    return draft, draft_len
+
+
+def spec_accept(logits, draft, draft_len, rng, temperature):
+    """Accept the longest agreeing draft prefix + the bonus token.
+
+    ``logits``: (B, K+1, V) verify-forward outputs — position i scored
+    the prefix extended by draft tokens 0..i-1. ``draft``: (B, K);
+    ``draft_len``: (B,) real draft tokens per row; ``temperature``: (B,)
+    per-row (0 = greedy, matching ``generate.sample_logits`` semantics).
+
+    Greedy rows accept draft[i] iff it equals argmax(logits[:, i]) —
+    byte-identical to sequential greedy decoding by construction.
+    Temperature rows accept draft[i] with probability p_i(draft[i])
+    (the proposal is a point mass) and on rejection resample from the
+    renormalized residual p_i with the rejected token's mass removed —
+    the Leviathan et al. rule specialized to a deterministic drafter, so
+    the emitted distribution is exactly the target distribution.
+
+    Returns ``(emitted, n_emit, n_acc)``: (B, K+1) tokens where
+    positions < n_emit are real (n_emit = n_acc + 1: accepted drafts
+    plus the bonus token at the first rejected / past-the-end position),
+    and n_acc the accepted-draft count. EOS/budget gating is the
+    caller's job (the engine masks emitted positions like any other
+    decode step output).
+    """
+    B, K1, V = logits.shape
+    K = K1 - 1
+    greedy_t = jnp.argmax(logits, axis=-1)                       # (B, K+1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None, None]
+    probs = jax.nn.softmax(scaled, axis=-1)                      # (B, K+1, V)
+    r_accept, r_bonus = jax.random.split(rng)
+    is_greedy = temperature <= 0.0                               # (B,)
+    if K > 0:
+        u = jax.random.uniform(r_accept, (B, K))
+        p_draft = jnp.take_along_axis(
+            probs[:, :K, :], draft[..., None], axis=-1
+        )[..., 0]                                                # (B, K)
+        acc = jnp.where(
+            is_greedy[:, None], draft == greedy_t[:, :K], u < p_draft
+        )
+        acc &= jnp.arange(K)[None, :] < draft_len[:, None]
+        # longest agreeing PREFIX: one disagreement poisons the tail
+        n_acc = jnp.sum(
+            jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1
+        ).astype(jnp.int32)
+    else:
+        n_acc = jnp.zeros((B,), jnp.int32)
+    # bonus token from position n_acc: the model's own next token given
+    # the accepted prefix (= the classic decode step when n_acc == 0)
+    p_b = jnp.take_along_axis(probs, n_acc[:, None, None], axis=1)[:, 0]
+    greedy_b = jnp.take_along_axis(greedy_t, n_acc[:, None], axis=1)[:, 0]
+    if K > 0:
+        rejected = n_acc < draft_len                             # (B,)
+        d_rej = jnp.take_along_axis(
+            draft, jnp.minimum(n_acc, K - 1)[:, None], axis=1
+        )[:, 0]
+        # residual: remove the rejected point mass, renormalize; a
+        # numerically-degenerate residual (all mass was on the draft)
+        # falls back to the unmodified distribution — it cannot occur
+        # for a genuinely rejected draw (u < p(d) would have accepted)
+        resid = p_b * (1.0 - jax.nn.one_hot(d_rej, V, dtype=p_b.dtype))
+        norm = resid.sum(-1, keepdims=True)
+        safe = norm > 0
+        resid = jnp.where(safe, resid / jnp.where(safe, norm, 1.0), p_b)
+        p_bonus = jnp.where(rejected[:, None], resid, p_b)
+    else:
+        p_bonus = p_b
+    drawn = jax.random.categorical(
+        r_bonus, jnp.log(jnp.clip(p_bonus, 1e-30, None)), axis=-1
+    )
+    bonus = jnp.where(is_greedy, greedy_b, drawn).astype(jnp.int32)
+    # emitted[i] = draft[i] for i < n_acc (greedy rows: == greedy_t[i]),
+    # the bonus at i == n_acc, padding past that
+    i = jnp.arange(K1)[None, :]
+    full = (
+        jnp.concatenate([draft, jnp.zeros((B, 1), draft.dtype)], axis=1)
+        if K > 0
+        else jnp.zeros((B, 1), jnp.int32)
+    )
+    emitted = jnp.where(
+        i < n_acc[:, None],
+        full,
+        jnp.where(i == n_acc[:, None], bonus[:, None], 0),
+    ).astype(jnp.int32)
+    n_emit = n_acc + 1
+    return emitted, n_emit, n_acc

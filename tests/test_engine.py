@@ -1100,6 +1100,41 @@ def test_idle_parks_without_busy_wake(model_and_params):
         eng.stop()
 
 
+def test_carry_upload_never_aliases_host_mirrors(model_and_params):
+    """Regression (CPU backend): jnp.asarray of an aligned numpy buffer
+    is ZERO-COPY, so an un-snapshotted carry upload aliases the live
+    host mirrors — a later in-place host edit (prefill activation, drain
+    refresh) retroactively rewrites what an in-flight chunk reads. That
+    raced as chunked-prefill rows truncating to their first token under
+    churn. The carry (and the paged device table) must be immune to
+    mirror mutation after upload."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=2, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+    )
+    eng.last_tok[:] = 7
+    eng.active[:] = False
+    eng._upload_carry()
+    c = eng._carry
+    eng.last_tok[:] = 99   # host edit AFTER upload
+    eng.active[:] = True
+    assert list(np.asarray(c["last_tok"])) == [7, 7]
+    assert list(np.asarray(c["active"])) == [False, False]
+    # paged block-table mirror: same invariant through the memo
+    from kubeflow_tpu.serve.paging import PageAllocator
+
+    pager = PageAllocator(
+        pool_tokens=16 * 8, page_size=16, max_batch=2, max_pages_per_row=4
+    )
+    pager.alloc(0, 2)
+    dev = pager.device_table(4)
+    before = np.asarray(dev).copy()
+    pager.free(0)
+    pager.alloc(1, 3)
+    assert (np.asarray(dev) == before).all()
+
+
 def test_engine_config_object_and_depth_validation(model_and_params):
     """LMEngineConfig bundles the knobs; unknown overrides and invalid
     pipeline depths fail loudly."""

@@ -465,3 +465,103 @@ def test_paged_pipelined_concurrent_with_backpressure(model_and_params):
     inline = run_mode(0)
     for i in range(len(prompts)):
         assert pipe[i] == inline[i], (i, pipe[i], inline[i])
+
+
+# ----------------------------------------------- speculative decoding (spec)
+
+
+def test_paged_spec_parity_across_horizon_growth(model_and_params):
+    """Speculative paged decode must stay byte-identical to the non-spec
+    paged engine while the page read window grows across chunks — the
+    horizon bound now grows +K per step (chunk_span), and beyond-budget
+    span positions must route to the scratch page, never clamp into the
+    row's own pages."""
+    model, params = model_and_params
+    kw = dict(
+        max_batch=2, max_seq=64, chunk_steps=4, prefill_buckets=(32,),
+        eos_id=EOS, kv_pool_tokens=16 * 12, page_size=16, seed=7,
+    )
+    rng = np.random.default_rng(61)
+    prompts = _prompts(rng, 3, lo=4, hi=11) + [[5, 6, 7] * 4]
+    outs = {}
+    for spec in (0, 4):
+        for depth in (0, 1):
+            eng = LMEngine(
+                model, CFG, params, pipeline_depth=depth,
+                spec_draft_tokens=spec, **kw
+            ).start()
+            try:
+                outs[(spec, depth)] = [
+                    eng.submit(p, max_new_tokens=40) for p in prompts
+                ]
+                assert eng.pager.used_pages == 0
+            finally:
+                eng.stop()
+    assert outs[(4, 0)] == outs[(0, 0)]
+    assert outs[(4, 1)] == outs[(0, 0)]
+    assert outs[(0, 1)] == outs[(0, 0)]
+
+
+def test_paged_spec_concurrent_with_backpressure(model_and_params):
+    """Spec + page backpressure (held admissions) + concurrent traffic:
+    answers equal the non-spec paged engine's, and the pool frees fully —
+    a speculative span must never leak pages of a retired row."""
+    model, params = model_and_params
+    kw = dict(
+        max_batch=3, max_seq=64, chunk_steps=4, prefill_buckets=(32,),
+        eos_id=EOS, kv_pool_tokens=16 * 7, page_size=16, seed=3,
+    )
+    rng = np.random.default_rng(67)
+    prompts = _prompts(rng, 6, lo=3, hi=14)
+
+    def run_mode(spec):
+        eng = LMEngine(
+            model, CFG, params, spec_draft_tokens=spec, **kw
+        ).start()
+        outs: dict[int, list[int]] = {}
+        errors: list[Exception] = []
+
+        def worker(i):
+            try:
+                time.sleep(0.015 * i)
+                outs[i] = eng.submit(prompts[i], max_new_tokens=10)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            assert not errors, errors
+            assert eng.pager.used_pages == 0  # no leaked pages
+        finally:
+            eng.stop()
+        return outs
+
+    assert run_mode(4) == run_mode(0)
+
+
+def test_paged_spec_temperature_determinism(model_and_params):
+    """Seeded rejection sampling on the paged cache: same seed → same
+    stream, twice, through fresh engines."""
+    model, params = model_and_params
+
+    def run():
+        eng = LMEngine(
+            model, CFG, params, max_batch=1, max_seq=64, chunk_steps=4,
+            prefill_buckets=(32,), eos_id=EOS, kv_pool_tokens=16 * 8,
+            page_size=16, seed=11, spec_draft_tokens=4,
+        ).start()
+        try:
+            return eng.submit([7, 8, 9] * 4, max_new_tokens=16,
+                              temperature=0.9)
+        finally:
+            eng.stop()
+
+    a, b = run(), run()
+    assert a == b and len(a) > 0
