@@ -124,6 +124,62 @@ def resume_backend(pid: int) -> None:
 
 
 # --------------------------------------------------------------------- #
+# engine faults (the watchdog's production seam: the pre-chunk hook)
+# --------------------------------------------------------------------- #
+
+
+def wedge_engine(engine, *, hold_s: float = 30.0):
+    """Stall the engine's NEXT device chunk dispatch: the scheduler thread
+    blocks inside the pre-chunk fault hook exactly as it would inside a
+    wedged device call — heartbeat stops advancing while work piles up,
+    which is the watchdog's trip condition. Returns ``release()``; the
+    stall also self-releases after ``hold_s`` so an un-watched engine
+    cannot stay wedged forever (the abandoned thread must eventually
+    observe its stop flag and exit).
+
+    One-shot: the hook uninstalls itself after the stall, so a restarted
+    (or released) engine decodes normally."""
+    import threading
+
+    released = threading.Event()
+    fired = threading.Event()
+
+    def hook(eng) -> None:
+        if fired.is_set():
+            return
+        fired.set()
+        record_injection("wedge_engine")
+        logger.warning(
+            "chaos: wedging engine for up to %.1fs (next chunk stalled)",
+            hold_s,
+        )
+        released.wait(hold_s)
+        eng._fault_hooks.pop("pre_chunk", None)
+
+    engine._fault_hooks["pre_chunk"] = hook
+    return released.set
+
+
+def slow_decode(engine, *, delay_s: float = 0.05):
+    """Inflate every chunk's latency by ``delay_s`` — the brownout (not
+    blackout) fault: decode throughput collapses, queue-wait estimates
+    grow, and deadline-aware admission control must start shedding.
+    Returns ``stop()`` to remove the hook."""
+    record_injection("slow_decode")
+
+    def hook(eng) -> None:
+        time.sleep(delay_s)
+
+    engine._fault_hooks["pre_chunk"] = hook
+
+    def stop() -> None:
+        if engine._fault_hooks.get("pre_chunk") is hook:
+            engine._fault_hooks.pop("pre_chunk", None)
+
+    return stop
+
+
+# --------------------------------------------------------------------- #
 # storage / transfer faults
 # --------------------------------------------------------------------- #
 

@@ -93,6 +93,31 @@ class DropSlice(Fault):
 
 
 @dataclasses.dataclass(frozen=True)
+class WedgeEngine(Fault):
+    """Serving fault: stall the named model's engine on its next device
+    chunk dispatch (the scheduler thread blocks as if inside a wedged
+    device call) for up to ``hold_s``. The engine watchdog must trip
+    (``kft_engine_watchdog_trips_total{reason="wedged"}``), flip
+    readiness, fail in-flight work retryably, and rebuild the engine.
+    ``at_step`` is ignored for serving faults — the runner fires them as
+    soon as the target engine resolves."""
+
+    model: str = ""
+    hold_s: float = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SlowDecode(Fault):
+    """Serving fault: inflate every decode chunk of the named model's
+    engine by ``delay_s`` — a brownout, not a blackout. Deadline-aware
+    admission control must start shedding provably-late requests with
+    503 + Retry-After instead of queueing them to a guaranteed miss."""
+
+    model: str = ""
+    delay_s: float = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
 class CorruptCheckpoint(Fault):
     """Silently flip one byte in the newest checkpoint step under
     ``directory`` (or an explicit ``step``) — the bit-rot/torn-copy case
@@ -106,7 +131,7 @@ class CorruptCheckpoint(Fault):
 FAULT_KINDS = {
     c.__name__: c
     for c in (CrashWorker, PreemptWorker, WedgeWorker, DropSlice,
-              CorruptCheckpoint)
+              WedgeEngine, SlowDecode, CorruptCheckpoint)
 }
 
 

@@ -32,8 +32,14 @@ from kubeflow_tpu.chaos.plan import (
     Fault,
     FaultPlan,
     PreemptWorker,
+    SlowDecode,
+    WedgeEngine,
     WedgeWorker,
 )
+
+#: serving fault kinds: target an LMEngine resolved by model name via the
+#: runner's ``engines`` mapping, not a training worker process
+_SERVING_FAULTS = (WedgeEngine, SlowDecode)
 from kubeflow_tpu.obs import heartbeat as hb
 from kubeflow_tpu.orchestrator.spec import WorkerPhase, WorkerStatus
 
@@ -62,10 +68,18 @@ class FiredFault:
 class ChaosRunner:
     """Injects one FaultPlan into one job; reusable across polls only."""
 
-    def __init__(self, cluster, uid: str, plan: FaultPlan):
+    def __init__(
+        self, cluster=None, uid: str = "", plan: FaultPlan | None = None,
+        *, engines=None,
+    ):
+        if plan is None:
+            raise ValueError("ChaosRunner needs a FaultPlan")
         self.cluster = cluster
         self.uid = uid
         self.plan = plan
+        #: model name → LMEngine, for serving faults (WedgeEngine /
+        #: SlowDecode); a plan naming a model absent here keeps pending
+        self.engines = dict(engines or {})
         self._rng = random.Random(plan.seed)
         self._pending: list[Fault] = list(plan.faults)
         self.fired: list[FiredFault] = []
@@ -75,6 +89,8 @@ class ChaosRunner:
     # -- observation ---------------------------------------------------- #
 
     def _workers(self) -> list[WorkerStatus]:
+        if self.cluster is None:  # serving-only plan: no training side
+            return []
         return [
             w for _, w in self.cluster.workers.list(prefix=f"{self.uid}/")
         ]
@@ -84,6 +100,8 @@ class ChaosRunner:
         stamps first (the drain writes one per completed step), stdout
         ``step=N`` metrics as the fallback for payloads that don't beat."""
         best = -1
+        if self.cluster is None:
+            return best
         workdir = self.cluster.launcher.workdir(self.uid)
         for w in self._workers():
             beat = hb.read_heartbeat(
@@ -121,6 +139,9 @@ class ChaosRunner:
     def _triggered(self, fault: Fault, step: int) -> list[WorkerStatus] | bool:
         """Truthy iff the fault should fire this pass (the worker targets
         for process faults; ``True`` for targetless checkpoint faults)."""
+        if isinstance(fault, _SERVING_FAULTS):
+            # serving faults key off engine presence, not trainer steps
+            return fault.model in self.engines
         if fault.at_step is not None and step < fault.at_step:
             return []
         if isinstance(fault, CorruptCheckpoint):
@@ -134,6 +155,22 @@ class ChaosRunner:
         ]
 
     def _fire(self, fault: Fault, targets, step: int) -> None:
+        if isinstance(fault, _SERVING_FAULTS):
+            engine = self.engines[fault.model]
+            if isinstance(fault, WedgeEngine):
+                injectors.wedge_engine(engine, hold_s=fault.hold_s)
+            else:
+                injectors.slow_decode(engine, delay_s=fault.delay_s)
+            logger.warning(
+                "chaos: fired %s on engine %r", fault.kind, fault.model
+            )
+            self.fired.append(
+                FiredFault(
+                    fault=fault, at_observed_step=step,
+                    fired_at=time.monotonic(), targets=[fault.model],
+                )
+            )
+            return
         if isinstance(fault, CorruptCheckpoint):
             _, victim = injectors.corrupt_checkpoint(
                 fault.directory, fault.step, rng=self._rng
@@ -202,7 +239,7 @@ class ChaosRunner:
                 del self._grace[key]
 
     def _note_recoveries(self, step: int) -> None:
-        job = self.cluster.get(self.uid)
+        job = self.cluster.get(self.uid) if self.cluster is not None else None
         finished_ok = (
             job is not None and job.status.finished
             and job.status.phase == "Succeeded"
@@ -210,8 +247,10 @@ class ChaosRunner:
         for rec in self.fired:
             if rec.recovered_after_s is not None:
                 continue
-            if isinstance(rec.fault, CorruptCheckpoint):
-                continue  # recovery asserted at restore time, not here
+            if isinstance(rec.fault, (CorruptCheckpoint, *_SERVING_FAULTS)):
+                # recovery asserted elsewhere (restore time / the serving
+                # watchdog's restart metrics), not by trainer progress
+                continue
             if finished_ok or step > rec.at_observed_step:
                 rec.recovered_after_s = time.monotonic() - rec.fired_at
                 injectors.RECOVERY_SECONDS.observe(rec.recovered_after_s)

@@ -245,6 +245,7 @@ def _cmd_serve(args) -> int:
     server = ModelServer(
         http_port=args.http_port,
         grpc_port=args.grpc_port,
+        default_deadline_ms=args.default_deadline_ms,
     )
     for spec in specs:
         spec.validate()
@@ -897,6 +898,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="storage-initializer destination (default: tmpdir)")
     s.add_argument("--port-file", default=None,
                    help="write the bound HTTP port here once listening")
+    s.add_argument("--default-deadline-ms", type=float, default=None,
+                   help="end-to-end budget applied to requests arriving "
+                        "without an x-kft-deadline-ms header (KServe "
+                        "request-timeout analog; default: unlimited)")
     s.set_defaults(fn=_cmd_serve)
 
     gw = sub.add_parser(

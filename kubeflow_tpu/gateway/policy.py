@@ -66,6 +66,10 @@ class TenantPolicy:
     bucket: TokenBucket | None = None
     max_in_flight: int | None = None
     in_flight: int = 0
+    #: shed order under sustained overload (higher = shed LAST): the
+    #: gateway stamps this as ``x-kft-priority`` and the engine's
+    #: admission control evicts the lowest-priority queued request first
+    priority: int = 0
 
 
 class PolicyEngine:
@@ -99,11 +103,19 @@ class PolicyEngine:
                     else None
                 ),
                 max_in_flight=cap,
+                priority=int(getattr(q, "priority", 0) or 0),
             )
         return cls(policies)
 
     def set(self, tenant: str, policy: TenantPolicy) -> None:
         self._policies[tenant] = policy
+
+    def priority_of(self, tenant: str) -> int | None:
+        """The tenant's shed priority, or None when unmanaged (the
+        gateway only overwrites ``x-kft-priority`` for managed tenants —
+        it is authoritative for them, a client cannot self-promote)."""
+        pol = self._policies.get(tenant)
+        return pol.priority if pol is not None else None
 
     def acquire(self, tenant: str) -> None:
         pol = self._policies.get(tenant)
@@ -130,6 +142,7 @@ class PolicyEngine:
                 "max_in_flight": pol.max_in_flight,
                 "in_flight": pol.in_flight,
                 "rate": pol.bucket.rate if pol.bucket else None,
+                "priority": pol.priority,
             }
             for tenant, pol in sorted(self._policies.items())
         }

@@ -59,6 +59,12 @@ from kubeflow_tpu.gateway.router import (
     ServiceRoute,
     affinity_key_of,
 )
+from kubeflow_tpu.serve.deadline import (
+    DEADLINE_ABS_HEADER,
+    DEADLINE_HEADER,
+    PRIORITY_HEADER,
+    deadline_from_headers,
+)
 
 REQUESTS = prom.REGISTRY.counter(
     names.GATEWAY_REQUESTS_TOTAL,
@@ -190,6 +196,7 @@ class GatewayConfig:
                 "max_rps": pol.get("maxRps"),
                 "burst": pol.get("burst"),
                 "max_in_flight": pol.get("maxInFlight"),
+                "priority": pol.get("priority", 0),
             }
         return cfg
 
@@ -245,6 +252,7 @@ class InferenceGateway:
                             else None
                         ),
                         max_in_flight=p.get("max_in_flight"),
+                        priority=int(p.get("priority") or 0),
                     ),
                 )
         self._budgets: dict[str, RetryBudget] = {}
@@ -387,6 +395,28 @@ class InferenceGateway:
             if k.lower() not in _HOP_HEADERS
         }
         fwd["x-request-id"] = req_id
+        # the absolute-deadline header is process-local (a monotonic
+        # stamp): one arriving off the wire is meaningless-to-hostile —
+        # never forward it, backends re-anchor from the ms budget
+        fwd.pop(DEADLINE_ABS_HEADER, None)
+        fwd.pop(DEADLINE_ABS_HEADER.title(), None)
+        #: the end-to-end budget, anchored at edge arrival: queue time in
+        #: the activator and retry rounds are charged against it. Only
+        #: the WIRE header counts — an absolute stamp arriving off the
+        #: wire is another process's clock (or an attacker's) and was
+        #: already stripped from fwd above.
+        deadline = deadline_from_headers(
+            {DEADLINE_HEADER: request.headers[DEADLINE_HEADER]}
+            if DEADLINE_HEADER in request.headers
+            else None
+        )
+        # managed tenants get their policy priority stamped (gateway-
+        # authoritative — a client cannot self-promote its shed order)
+        tenant = request.headers.get("x-kft-tenant", "default")
+        prio = self.policy.priority_of(tenant)
+        if prio is not None:
+            fwd.pop(PRIORITY_HEADER.title(), None)
+            fwd[PRIORITY_HEADER] = str(prio)
         is_stream = path.endswith("/generate_stream")
         idempotent = request.method == "GET" or any(
             path.endswith(s) for s in _IDEMPOTENT_SUFFIXES
@@ -401,6 +431,25 @@ class InferenceGateway:
         attempts = 0
         last_err: _UpstreamError | None = None
         while True:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # every hop downstream would shed it too — fail at the
+                    # edge with the shed marker (503 + Retry-After), and
+                    # never as a retryable backend failure
+                    SHED.labels(service=route.name, reason="deadline").inc()
+                    raise web.HTTPServiceUnavailable(
+                        reason="request deadline expired at the gateway",
+                        headers={"Retry-After": "1"},
+                    )
+                # rewrite the wire budget to what is LEFT: edge queue time
+                # and earlier attempts are charged, so the backend's own
+                # admission control sees the truth
+                fwd[DEADLINE_HEADER.title()] = str(
+                    max(1, int(remaining * 1e3))
+                )
+                fwd.pop(DEADLINE_HEADER, None)
             backend = self._select(route, revision, affinity_key)
             if backend is None:
                 parks += 1
@@ -429,7 +478,7 @@ class InferenceGateway:
                     )
                 return await self._attempt(
                     route, backend, request.method, path, fwd, body,
-                    idempotent=idempotent,
+                    idempotent=idempotent, timeout_s=remaining,
                 )
             except _UpstreamError as e:
                 last_err = e
@@ -508,20 +557,29 @@ class InferenceGateway:
         body: bytes,
         *,
         idempotent: bool,
+        timeout_s: float | None = None,
     ):
         if (
             route.hedge_ms is not None
             and idempotent
             and len(self.pool.selectable(route.name)) > 1
         ):
-            return await self._hedged(route, backend, method, path, fwd, body)
-        return await self._attempt_once(route, backend, method, path, fwd, body)
+            return await self._hedged(
+                route, backend, method, path, fwd, body, timeout_s
+            )
+        return await self._attempt_once(
+            route, backend, method, path, fwd, body, timeout_s
+        )
 
-    async def _hedged(self, route, primary, method, path, fwd, body):
+    async def _hedged(
+        self, route, primary, method, path, fwd, body, timeout_s=None
+    ):
         """Race a second attempt dispatched ``hedge_ms`` after the first;
         first success wins, the loser is cancelled."""
         first = asyncio.ensure_future(
-            self._attempt_once(route, primary, method, path, fwd, body)
+            self._attempt_once(
+                route, primary, method, path, fwd, body, timeout_s
+            )
         )
         done, _ = await asyncio.wait(
             {first}, timeout=route.hedge_ms / 1e3
@@ -534,7 +592,7 @@ class InferenceGateway:
         HEDGES.labels(service=route.name).inc()
         second = asyncio.ensure_future(
             self._attempt_once(
-                route, second_backend, method, path, fwd, body
+                route, second_backend, method, path, fwd, body, timeout_s
             )
         )
         pending = {first, second}
@@ -557,11 +615,17 @@ class InferenceGateway:
         raise err
 
     async def _attempt_once(
-        self, route, backend: Backend, method, path, fwd, body
+        self, route, backend: Backend, method, path, fwd, body,
+        timeout_s: float | None = None,
     ):
         import aiohttp
         from aiohttp import web
 
+        total = self.config.upstream_timeout_s
+        if timeout_s is not None:
+            # a deadline-bearing request never waits on a backend longer
+            # than its remaining budget
+            total = min(total, max(timeout_s, 0.001))
         self.pool.acquire(backend)
         try:
             async with self._session.request(
@@ -570,7 +634,7 @@ class InferenceGateway:
                 data=body if method not in ("GET", "HEAD") else None,
                 headers=fwd,
                 timeout=aiohttp.ClientTimeout(
-                    total=self.config.upstream_timeout_s,
+                    total=total,
                     sock_connect=self.config.connect_timeout_s,
                 ),
             ) as upstream:
@@ -579,11 +643,24 @@ class InferenceGateway:
                 ctype = upstream.headers.get(
                     "Content-Type", "application/json"
                 )
+                retry_after = upstream.headers.get("Retry-After")
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
             self.pool.record(backend, ok=False)
             raise _UpstreamError(backend, e) from e
         finally:
             self.pool.release(backend)
+        if status == 503 and retry_after is not None:
+            # coherent load shed (deadline-expired / admission-shed), NOT
+            # backend death: pass it through with its Retry-After. No
+            # retry (every replica would shed it identically — a retry
+            # storm is how brownouts become blackouts) and no breaker
+            # penalty (the replica answered rationally).
+            self.pool.record(backend, ok=True)
+            SHED.labels(service=route.name, reason="upstream_shed").inc()
+            return web.Response(
+                body=payload, status=status,
+                headers={"Content-Type": ctype, "Retry-After": retry_after},
+            )
         if status in _BACKEND_FAILURE_STATUSES:
             self.pool.record(backend, ok=False)
             raise _UpstreamError(
@@ -623,20 +700,32 @@ class InferenceGateway:
                 self.pool.record(backend, ok=False)
                 raise _UpstreamError(backend, e) from e
             if upstream.status != 200:
-                # pre-stream refusal (429 overload, 400, 501): pass through
+                # pre-stream refusal (429 overload, 400, 501, deadline
+                # shed): pass through. A 503 carrying Retry-After is a
+                # coherent shed, not backend trouble — no breaker penalty.
                 payload = await upstream.read()
-                if upstream.status in _BACKEND_FAILURE_STATUSES:
-                    self.pool.record(backend, ok=False)
-                else:
-                    self.pool.record(backend, ok=True)
+                shed_503 = (
+                    upstream.status == 503
+                    and "Retry-After" in upstream.headers
+                )
+                if shed_503:
+                    SHED.labels(
+                        service=route.name, reason="upstream_shed"
+                    ).inc()
+                self.pool.record(
+                    backend,
+                    ok=shed_503
+                    or upstream.status not in _BACKEND_FAILURE_STATUSES,
+                )
+                hdrs = {
+                    "Content-Type": upstream.headers.get(
+                        "Content-Type", "application/json"
+                    )
+                }
+                if "Retry-After" in upstream.headers:
+                    hdrs["Retry-After"] = upstream.headers["Retry-After"]
                 return web.Response(
-                    body=payload,
-                    status=upstream.status,
-                    headers={
-                        "Content-Type": upstream.headers.get(
-                            "Content-Type", "application/json"
-                        )
-                    },
+                    body=payload, status=upstream.status, headers=hdrs
                 )
             resp = web.StreamResponse(
                 headers={

@@ -136,3 +136,17 @@ ENGINE_PREFIX_TOKENS_STORED = "kft_engine_prefix_tokens_stored"
 ENGINE_SPEC_PROPOSED_TOTAL = "kft_engine_spec_proposed_total"
 ENGINE_SPEC_ACCEPTED_TOTAL = "kft_engine_spec_accepted_total"
 ENGINE_SPEC_ACCEPTANCE = "kft_engine_spec_acceptance"
+
+# -- serving SRE layer (serve/deadline.py, serve/watchdog.py) ------------ #
+
+#: counter{stage} — requests retired because their end-to-end deadline
+#: expired (admission / queued / decoding / wait / batch_queue)
+ENGINE_DEADLINE_EXPIRED_TOTAL = "kft_engine_deadline_expired_total"
+#: counter{reason} — requests shed by deadline-aware admission control
+#: (deadline_unmeetable / priority_evict) BEFORE costing a decode slot
+ENGINE_ADMISSION_SHED_TOTAL = "kft_engine_admission_shed_total"
+#: counter{model,reason} — engine watchdog trips (wedged / loop_dead /
+#: fatal); each trip flips readiness and triggers a supervised restart
+ENGINE_WATCHDOG_TRIPS_TOTAL = "kft_engine_watchdog_trips_total"
+#: counter{model} — supervised engine restarts (device state rebuilt)
+ENGINE_RESTARTS_TOTAL = "kft_engine_restarts_total"

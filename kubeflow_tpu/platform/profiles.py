@@ -37,6 +37,9 @@ class ResourceQuota:
     burst: int | None = None
     #: serving: concurrent in-flight requests at the gateway
     max_concurrent_requests: int | None = None
+    #: serving: overload shed order (higher = shed LAST); stamped by the
+    #: gateway as x-kft-priority and honored by engine admission control
+    priority: int = 0
 
 
 @dataclasses.dataclass

@@ -14,7 +14,10 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import time
 from typing import Any, Awaitable, Callable, Sequence
+
+from kubeflow_tpu.serve.deadline import DEADLINE_EXPIRED, DeadlineExceeded
 
 
 @dataclasses.dataclass
@@ -40,16 +43,19 @@ class Batcher:
     ):
         self._handler = handler
         self.config = config or BatcherConfig()
-        self._queue: list[tuple[list[Any], asyncio.Future]] = []
+        self._queue: list[tuple[list[Any], asyncio.Future, float | None]] = []
         self._flush_task: asyncio.Task | None = None
         self._lock = asyncio.Lock()
-        self.stats = {"batches": 0, "instances": 0, "fail_isolations": 0}
+        self.stats = {
+            "batches": 0, "instances": 0, "fail_isolations": 0,
+            "deadline_shed": 0,
+        }
 
     @property
     def queue_depth(self) -> int:
         """Instances waiting for the next flush — the balancer's backlog
         signal, exported as ``kft_server_queue_depth`` on /metrics."""
-        return sum(len(i) for i, _ in self._queue)
+        return sum(len(i) for i, _, _ in self._queue)
 
     @property
     def mean_occupancy(self) -> float:
@@ -60,12 +66,18 @@ class Batcher:
         batches = self.stats["batches"]
         return self.stats["instances"] / batches if batches else 0.0
 
-    async def submit(self, instances: list[Any]) -> list[Any]:
+    async def submit(
+        self, instances: list[Any], *, deadline: float | None = None
+    ) -> list[Any]:
+        """``deadline`` (absolute ``time.monotonic()``) rides the queue
+        entry: an entry whose deadline passes before its flush is shed
+        with :class:`DeadlineExceeded` instead of costing a forward."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        batch: list[tuple[list[Any], asyncio.Future]] | None = None
+        batch: list[tuple[list[Any], asyncio.Future, float | None]] | None
+        batch = None
         async with self._lock:
-            self._queue.append((instances, fut))
-            queued = sum(len(i) for i, _ in self._queue)
+            self._queue.append((instances, fut, deadline))
+            queued = sum(len(i) for i, _, _ in self._queue)
             if queued >= self.config.max_batch_size:
                 batch = self._pop_locked()
             elif self._flush_task is None:
@@ -82,16 +94,42 @@ class Batcher:
         if batch:
             await self._run_batch(batch)
 
-    def _pop_locked(self) -> list[tuple[list[Any], asyncio.Future]]:
+    def _pop_locked(self) -> list[tuple[list[Any], asyncio.Future, float | None]]:
         if self._flush_task is not None and self._flush_task is not asyncio.current_task():
             self._flush_task.cancel()
             self._flush_task = None
         queue, self._queue = self._queue, []
         return queue
 
-    async def _run_batch(self, queue: list[tuple[list[Any], asyncio.Future]]) -> None:
+    def _shed_expired(
+        self, queue: list[tuple[list[Any], asyncio.Future, float | None]]
+    ) -> list[tuple[list[Any], asyncio.Future, float | None]]:
+        """Fail queued entries whose deadline passed while they waited for
+        the flush — they must never consume a forward's batch slot."""
+        now = time.monotonic()
+        kept = []
+        for instances, fut, deadline in queue:
+            if deadline is not None and now > deadline and not fut.done():
+                self.stats["deadline_shed"] += 1
+                DEADLINE_EXPIRED.labels(stage="batch_queue").inc()
+                fut.set_exception(
+                    DeadlineExceeded(
+                        "deadline expired in the batch queue",
+                        stage="batch_queue",
+                    )
+                )
+            else:
+                kept.append((instances, fut, deadline))
+        return kept
+
+    async def _run_batch(
+        self, queue: list[tuple[list[Any], asyncio.Future, float | None]]
+    ) -> None:
+        queue = self._shed_expired(queue)
+        if not queue:
+            return
         flat: list[Any] = []
-        for instances, _ in queue:
+        for instances, _, _ in queue:
             flat.extend(instances)
         try:
             outputs: list[Any] = []
@@ -101,7 +139,7 @@ class Batcher:
                 self.stats["batches"] += 1
         except Exception as e:
             if len(queue) == 1:
-                _, fut = queue[0]
+                _, fut, _ = queue[0]
                 if not fut.done():
                     fut.set_exception(e)
                 return
@@ -112,7 +150,7 @@ class Batcher:
             # failure — and the isolation event itself is counted so
             # operators can see offender-isolation churn on /metrics.
             self.stats["fail_isolations"] += 1
-            for instances, fut in queue:
+            for instances, fut, _ in queue:
                 if fut.done():
                     continue
                 try:
@@ -124,7 +162,7 @@ class Batcher:
             return
         self.stats["instances"] += len(flat)
         off = 0
-        for instances, fut in queue:
+        for instances, fut, _ in queue:
             n = len(instances)
             if not fut.done():
                 fut.set_result(outputs[off : off + n])

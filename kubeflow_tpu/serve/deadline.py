@@ -1,0 +1,153 @@
+"""End-to-end request deadlines: the serving plane's timeout contract.
+
+Reference analogs: KServe's per-InferenceService request ``timeout`` and
+Knative's activator deadline propagation (SURVEY.md §2.2) — a request
+carries ONE budget from the edge to the accelerator, and every hop
+charges its queue/service time against that same budget instead of
+stacking independent per-hop timeouts (which is how a 300 s server
+timeout hides a request that died at its client 290 s ago).
+
+Wire contract:
+
+- ``x-kft-deadline-ms`` — the *remaining* budget in milliseconds, set by
+  the client (or the gateway's tenant policy) and REWRITTEN by the
+  gateway at each dispatch so edge queue time is charged to the budget;
+- ``x-kft-deadline-abs`` — process-local absolute ``time.monotonic()``
+  deadline, stamped once at DataPlane admission so in-process consumers
+  (batcher, engine) share one clock edge instead of re-parsing the
+  relative header at different instants. Never crosses a process.
+- ``x-kft-priority`` — integer tenant priority (higher = shed last),
+  stamped by the gateway from ``TenantPolicy.priority``; under sustained
+  overload the engine evicts the lowest-priority queued request first.
+
+Error taxonomy (the gateway's retry classifier keys off it):
+
+- :class:`DeadlineExceeded` — the budget ran out (queued, mid-decode, or
+  at the caller's wait). Mapped to 503 + ``Retry-After``: retrying the
+  same request elsewhere cannot help, every replica sheds it identically.
+- :class:`AdmissionShed` — admission control proved the deadline
+  unmeetable (or a higher-priority request took the queue slot) and shed
+  the request BEFORE it cost a decode slot. 503 + ``Retry-After`` with a
+  backlog-drain estimate.
+
+Both carry ``Retry-After`` — the marker the gateway treats as "coherent
+load shed, do not burn retry budget", versus a bare 503 ("backend broke,
+retry elsewhere").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Mapping
+
+from kubeflow_tpu.obs import names, prom
+
+#: wire header: remaining budget in milliseconds (client/gateway-set)
+DEADLINE_HEADER = "x-kft-deadline-ms"
+#: process-local absolute time.monotonic() deadline (DataPlane-stamped)
+DEADLINE_ABS_HEADER = "x-kft-deadline-abs"
+#: integer tenant priority, higher = shed last (gateway-stamped)
+PRIORITY_HEADER = "x-kft-priority"
+
+DEADLINE_EXPIRED = prom.REGISTRY.counter(
+    names.ENGINE_DEADLINE_EXPIRED_TOTAL,
+    "requests retired because their end-to-end deadline expired",
+    ("stage",),
+)
+ADMISSION_SHED = prom.REGISTRY.counter(
+    names.ENGINE_ADMISSION_SHED_TOTAL,
+    "requests shed by deadline-aware admission control",
+    ("reason",),
+)
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's end-to-end budget ran out. Subclasses TimeoutError so
+    pre-deadline callers (``except TimeoutError``) keep working.
+
+    ``stage`` names where the budget died: ``admission`` (already expired
+    on arrival), ``queued`` (retired from the admission queue before
+    costing a decode slot), ``decoding`` (cancelled at an epoch
+    boundary), ``wait`` (the caller's own wait), ``batch_queue`` (shed
+    from the batcher's flush).
+    """
+
+    def __init__(self, message: str, *, stage: str = "wait"):
+        super().__init__(message)
+        self.stage = stage
+        self.retry_after_s = 1.0
+
+
+class AdmissionShed(RuntimeError):
+    """Shed at admission time, before any decode slot was consumed.
+
+    ``reason``: ``deadline_unmeetable`` (estimated queue wait + decode
+    time provably exceeds the remaining budget) or ``priority_evict``
+    (a higher-priority request took this one's queue slot under
+    sustained overload). ``retry_after_s`` estimates when the backlog
+    should have drained — surfaced as the 503's ``Retry-After``.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        reason: str = "deadline_unmeetable",
+        retry_after_s: float = 1.0,
+    ):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = max(1.0, float(retry_after_s))
+
+
+def deadline_from_headers(
+    headers: Mapping[str, str] | None,
+    *,
+    clock: Callable[[], float] = time.monotonic,
+) -> float | None:
+    """Absolute monotonic deadline carried by ``headers`` (the stamped
+    absolute header wins; else the relative ms budget is anchored at
+    ``clock()`` now). Absent/unparseable headers mean no deadline."""
+    if not headers:
+        return None
+    # header maps may be CIMultiDict (aiohttp) or plain dict — probe both
+    # spellings rather than lowercasing a copy per request
+    absolute = headers.get(DEADLINE_ABS_HEADER) or headers.get(
+        DEADLINE_ABS_HEADER.title()
+    )
+    if absolute is not None:
+        try:
+            return float(absolute)
+        except ValueError:
+            return None
+    raw = headers.get(DEADLINE_HEADER) or headers.get(DEADLINE_HEADER.title())
+    if raw is None:
+        return None
+    try:
+        budget_ms = float(raw)
+    except ValueError:
+        return None
+    return clock() + budget_ms / 1e3
+
+
+def priority_from_headers(headers: Mapping[str, str] | None) -> int:
+    if not headers:
+        return 0
+    raw = headers.get(PRIORITY_HEADER) or headers.get(PRIORITY_HEADER.title())
+    if raw is None:
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        return 0
+
+
+def remaining_s(
+    deadline: float | None,
+    *,
+    clock: Callable[[], float] = time.monotonic,
+) -> float | None:
+    """Seconds of budget left (may be negative); None when no deadline."""
+    if deadline is None:
+        return None
+    return deadline - clock()

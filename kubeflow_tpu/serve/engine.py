@@ -62,6 +62,14 @@ from kubeflow_tpu.models.transformer import (
     TransformerLM,
     init_kv_cache,
 )
+from kubeflow_tpu.serve.deadline import (
+    ADMISSION_SHED,
+    DEADLINE_EXPIRED,
+    AdmissionShed,
+    DeadlineExceeded,
+    deadline_from_headers,
+    priority_from_headers,
+)
 from kubeflow_tpu.serve.generate import (
     LMRuntimeModel,
     decode_kv_mask,
@@ -153,6 +161,13 @@ class _Request:
     # consumer walked away (client disconnect): free the row at the next
     # chunk boundary instead of decoding tokens nobody reads
     cancelled: threading.Event = field(default_factory=threading.Event)
+    # end-to-end deadline (absolute time.monotonic()): expired requests
+    # are retired from the queue before ever costing a decode slot, and
+    # mid-decode rows are cancelled at the next epoch boundary
+    deadline: float | None = None
+    # tenant priority (higher = shed last): under sustained overload the
+    # lowest-priority queued request is evicted first
+    priority: int = 0
     # set on admission:
     row: int = -1
     gen_start: int = 0
@@ -350,10 +365,23 @@ class LMEngine:
 
         self._pending: queue.Queue[_Request] = queue.Queue()
         self._fatal: Exception | None = None
+        #: watchdog poisoning: set (with the retryable EngineRestarting)
+        #: while a supervised restart tears this instance down — submits
+        #: racing the swap fail fast with the retryable error, not a 500
+        self._poisoned: Exception | None = None
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        #: scheduler-loop heartbeat (monotonic): stamped at the top of
+        #: every loop iteration — the watchdog's wedge signal is this
+        #: going stale while the engine has work
+        self._beat = time.monotonic()
+        #: chaos seam (chaos/injectors.py wedge_engine / slow_decode):
+        #: a "pre_chunk" hook runs on the scheduler thread before each
+        #: chunk dispatch. Production never populates this dict; the cost
+        #: is one dict lookup per chunk.
+        self._fault_hooks: dict[str, Any] = {}
         self.stats = {
             "admitted": 0, "completed": 0, "chunks": 0,
             "max_concurrent": 0, "prefix_hits": 0, "prefix_tokens_reused": 0,
@@ -361,6 +389,10 @@ class LMEngine:
             # speculative decoding: drafts proposed/accepted (the tokens-
             # per-forward multiplier — kft_engine_spec_*_total)
             "spec_proposed": 0, "spec_accepted": 0,
+            # SRE layer: deadline retirements by stage + admission sheds
+            # (pre-initialized: /metrics iterates from another thread)
+            "deadline_expired_queued": 0, "deadline_expired_decoding": 0,
+            "shed_deadline": 0, "shed_priority": 0,
         }
         # pipelined-decode state: the device-resident carry of per-row
         # scheduling arrays, its dirtiness (host edits pending merge), and
@@ -923,17 +955,123 @@ class LMEngine:
             req.error = err
             req.finish()
 
+    # -- SRE surface: liveness, poisoning, admission estimation ------------- #
+
+    def heartbeat(self) -> float:
+        """Monotonic stamp of the scheduler loop's last iteration start."""
+        return self._beat
+
+    def busy(self) -> bool:
+        """True when the engine has work a wedged loop would be stalling:
+        active decode rows, queued admissions, prefills in flight, or a
+        page-held request."""
+        return bool(
+            self.active.any()
+            or self._pending.qsize()
+            or self._prefilling
+            or (self.paged and self._held is not None)
+        )
+
+    def poison(self, err: Exception) -> None:
+        """Fail every in-flight and queued request with ``err`` NOW and
+        stop accepting work — WITHOUT joining the scheduler thread (it
+        may be wedged inside a device call; it observes ``_stop`` when
+        the call returns and exits on its own). The watchdog calls this
+        before rebuilding; the drain mirrors the fatal path."""
+        self._poisoned = err
+        self._stop.set()
+        self._work.set()
+        for row in range(self.max_batch):
+            req = self._slots[row]
+            if req is not None:
+                self._slots[row] = None
+                req.error = err
+                req.finish()
+        if self.paged and self._held is not None:
+            self._held.error = err
+            self._held.finish()
+            self._held = None
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            req.error = err
+            req.finish()
+
+    def estimate_admission(
+        self, max_new_tokens: int
+    ) -> tuple[float, float] | None:
+        """(queue_wait_s, decode_s) estimate for a request admitted now,
+        from the decode-gap EWMA the pipelined loop already tracks. None
+        while the EWMA is cold (no evidence → never shed on a guess).
+
+        ``decode_s`` uses the chunk *span* (steps × K+1 under
+        speculation) — an upper bound on tokens per chunk, so the shed
+        decision errs toward admitting. ``queue_wait_s`` models the
+        backlog as admission waves: requests queued ahead of this one
+        drain ``max_batch`` at a time, each wave lasting the mean
+        remaining decode time of the currently active rows."""
+        gap_s = self.overlap["decode_gap_ms"] / 1e3
+        if gap_s <= 0.0:
+            return None
+        span = self._chunk_span
+        decode_s = -(-max_new_tokens // span) * gap_s
+        queued = self._pending.qsize() + (
+            1 if self.paged and self._held is not None else 0
+        )
+        free = sum(s is None for s in self._slots)
+        if queued < free:
+            return 0.0, decode_s
+        act = self.active
+        if act.any():
+            mean_remaining = float(
+                (self.budget - self.gen_count)[act].mean()
+            )
+        else:
+            mean_remaining = float(max_new_tokens)
+        wave_s = max(1.0, mean_remaining / span) * gap_s
+        waves = -(-(queued + 1 - free) // self.max_batch)
+        return waves * wave_s, decode_s
+
     def _enqueue(
-        self, ids, max_new_tokens, temperature, *, live: bool
+        self, ids, max_new_tokens, temperature, *, live: bool,
+        deadline: float | None = None, priority: int = 0,
     ) -> _Request:
         if not ids:
             raise ValueError("empty prompt")
+        if self._poisoned is not None:
+            raise self._poisoned
         if self._fatal is not None:
             raise RuntimeError("LM engine is dead") from self._fatal
         if self._stop.is_set():
             # a submit racing (or following) stop() must fail NOW — the
             # scheduler thread is gone and nothing would ever service it
             raise RuntimeError("LM engine stopped")
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                DEADLINE_EXPIRED.labels(stage="admission").inc()
+                raise DeadlineExceeded(
+                    "deadline already expired at admission",
+                    stage="admission",
+                )
+            est = self.estimate_admission(max_new_tokens)
+            if est is not None:
+                queue_wait_s, decode_s = est
+                if queue_wait_s + decode_s > remaining:
+                    # shed BEFORE the request costs a decode slot: by the
+                    # throughput evidence in hand it cannot finish inside
+                    # its budget — 503 + Retry-After (backlog drain time)
+                    self.stats["shed_deadline"] += 1
+                    ADMISSION_SHED.labels(reason="deadline_unmeetable").inc()
+                    raise AdmissionShed(
+                        f"deadline unmeetable: ~{queue_wait_s:.1f}s queue "
+                        f"+ ~{decode_s:.1f}s decode > {remaining:.1f}s "
+                        "remaining",
+                        reason="deadline_unmeetable",
+                        retry_after_s=queue_wait_s,
+                    )
         # bounded admission: total outstanding work (rows decoding + queue)
         # beyond max_batch + max_queue is shed — an unbounded tail would
         # wait longer than any client timeout
@@ -943,11 +1081,12 @@ class LMEngine:
             self._pending.qsize() + occupied + held
             >= self.max_batch + self.max_queue
         ):
-            raise EngineOverloaded(
-                f"engine at capacity ({occupied} decoding, "
-                f"{self._pending.qsize() + held} queued, "
-                f"max_queue={self.max_queue})"
-            )
+            if not self._evict_lower_priority(priority):
+                raise EngineOverloaded(
+                    f"engine at capacity ({occupied} decoding, "
+                    f"{self._pending.qsize() + held} queued, "
+                    f"max_queue={self.max_queue})"
+                )
         if self.paged:
             # token space is contiguous in paged mode (no bucket-padding
             # gap), so the layout IS the prompt itself
@@ -993,6 +1132,7 @@ class LMEngine:
         req = _Request(
             list(ids), max_new_tokens, temperature,
             live=queue.Queue() if live else None,
+            deadline=deadline, priority=priority,
         )
         self._pending.put(req)
         self._work.set()
@@ -1008,6 +1148,34 @@ class LMEngine:
             req.finish()
         return req
 
+    def _evict_lower_priority(self, priority: int) -> bool:
+        """Under overload, shed the lowest-priority queued request whose
+        priority is strictly below the newcomer's — lowest-priority
+        tenants brown out first instead of FIFO arrival luck deciding.
+        Returns True when a slot was freed. Only QUEUED requests are
+        victims: evicting an active row would waste decode work."""
+        with self._pending.mutex:
+            victim = None
+            for cand in self._pending.queue:
+                if cand.done.is_set() or cand.cancelled.is_set():
+                    continue
+                if cand.priority < priority and (
+                    victim is None or cand.priority < victim.priority
+                ):
+                    victim = cand
+            if victim is None:
+                return False
+            self._pending.queue.remove(victim)
+        self.stats["shed_priority"] += 1
+        ADMISSION_SHED.labels(reason="priority_evict").inc()
+        victim.error = AdmissionShed(
+            f"shed by a priority-{priority} request under overload "
+            f"(this request: priority {victim.priority})",
+            reason="priority_evict",
+        )
+        victim.finish()
+        return True
+
     def submit(
         self,
         ids: list[int],
@@ -1015,10 +1183,25 @@ class LMEngine:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         timeout_s: float = 300.0,
+        deadline: float | None = None,
+        priority: int = 0,
     ) -> list[int]:
-        req = self._enqueue(ids, max_new_tokens, temperature, live=False)
-        if not req.done.wait(timeout_s):
-            raise TimeoutError("generation timed out")
+        """``deadline`` (absolute ``time.monotonic()``) is the end-to-end
+        budget; ``timeout_s`` is the legacy knob and becomes the deadline
+        when none is given — one clock governs queue wait AND decode."""
+        if deadline is None:
+            deadline = time.monotonic() + timeout_s
+        req = self._enqueue(
+            ids, max_new_tokens, temperature, live=False,
+            deadline=deadline, priority=priority,
+        )
+        if not req.done.wait(max(0.0, deadline - time.monotonic())):
+            # hand the row back: a timed-out caller must not leave its
+            # row decoding tokens nobody will read
+            req.cancelled.set()
+            self._work.set()
+            DEADLINE_EXPIRED.labels(stage="wait").inc()
+            raise DeadlineExceeded("generation timed out", stage="wait")
         if req.error is not None:
             raise req.error
         return req.tokens
@@ -1030,16 +1213,33 @@ class LMEngine:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         timeout_s: float = 300.0,
+        deadline: float | None = None,
+        priority: int = 0,
     ):
         """Yields lists of new tokens as decode chunks complete — the
-        streaming data path (KServe v2 generate_stream analog)."""
-        req = self._enqueue(ids, max_new_tokens, temperature, live=True)
+        streaming data path (KServe v2 generate_stream analog).
+
+        Every wait is charged against ONE monotonic deadline: the old
+        per-item ``get(timeout=timeout_s)`` granted the full budget per
+        chunk, so a slow stream could overrun it by tokens × timeout."""
+        if deadline is None:
+            deadline = time.monotonic() + timeout_s
+        req = self._enqueue(
+            ids, max_new_tokens, temperature, live=True,
+            deadline=deadline, priority=priority,
+        )
         try:
             while True:
+                remaining = deadline - time.monotonic()
                 try:
-                    item = req.live.get(timeout=timeout_s)
+                    if remaining <= 0:
+                        raise queue.Empty
+                    item = req.live.get(timeout=remaining)
                 except queue.Empty:
-                    raise TimeoutError("generation timed out") from None
+                    DEADLINE_EXPIRED.labels(stage="wait").inc()
+                    raise DeadlineExceeded(
+                        "generation timed out", stage="wait"
+                    ) from None
                 if item is None:
                     break
                 yield item
@@ -1061,11 +1261,28 @@ class LMEngine:
         )
 
     def _admit_all(self) -> None:
-        # cancelled mid-generation rows free up before admission looks for
-        # space — a disconnected client must not hold a row
+        # cancelled and deadline-expired mid-generation rows free up before
+        # admission looks for space — a disconnected client must not hold a
+        # row, and a row past its budget must stop costing decode steps.
+        # This runs at the top of every loop iteration, i.e. exactly the
+        # PR 6 epoch seam: _finish dirties the carry, the in-flight chunk
+        # drain-merges with the retired row masked out, then ONE re-upload.
+        now = time.monotonic()
         for row in range(self.max_batch):
             req = self._slots[row]
-            if req is not None and req.cancelled.is_set():
+            if req is None:
+                continue
+            # deadline before cancellation: a timed-out caller sets BOTH
+            # (cancel reclaims the row), and the retirement must be
+            # attributed to the deadline, not to a client walk-away
+            if req.deadline is not None and now > req.deadline:
+                self.stats["deadline_expired_decoding"] += 1
+                DEADLINE_EXPIRED.labels(stage="decoding").inc()
+                req.error = DeadlineExceeded(
+                    "deadline expired mid-decode", stage="decoding"
+                )
+                self._finish(row)
+            elif req.cancelled.is_set():
                 self._finish(row)
         while True:
             free = [i for i, s in enumerate(self._slots) if s is None]
@@ -1078,6 +1295,19 @@ class LMEngine:
                     req = self._pending.get_nowait()
                 except queue.Empty:
                     return
+            if req.done.is_set():
+                continue  # priority-evicted while queued: already failed
+            if req.deadline is not None and time.monotonic() > req.deadline:
+                # retired from the queue before ever costing a decode slot
+                # (checked before cancellation: a timed-out caller sets
+                # both, and the deadline is the cause)
+                self.stats["deadline_expired_queued"] += 1
+                DEADLINE_EXPIRED.labels(stage="queued").inc()
+                req.error = DeadlineExceeded(
+                    "deadline expired while queued", stage="queued"
+                )
+                req.finish()
+                continue
             if req.cancelled.is_set():
                 req.finish()  # consumer already gone: never admit
                 continue
@@ -1355,6 +1585,9 @@ class LMEngine:
     def _loop_inner(self) -> None:
         pending: _PendingChunk | None = None
         while not self._stop.is_set():
+            # watchdog heartbeat: stale while work exists ⇒ the loop is
+            # wedged inside a device call (or a chaos hook)
+            self._beat = time.monotonic()
             self._admit_all()
             self._advance_prefills()  # one piece per prefilling row
             if not self.active.any():
@@ -1489,6 +1722,11 @@ class LMEngine:
         device handles immediately) and thread the returned per-row arrays
         into the carry for the next dispatch: the steady state performs
         zero per-chunk H2D of per-row arrays."""
+        hook = self._fault_hooks.get("pre_chunk")
+        if hook is not None:
+            # chaos seam: WedgeEngine blocks here (the watchdog's wedge
+            # signal), SlowDecode sleeps here (inflated chunk latency)
+            hook(self)
         now = time.perf_counter()
         if self._last_dispatch is not None:
             self._ewma("decode_gap_ms", (now - self._last_dispatch) * 1e3)
@@ -1700,7 +1938,9 @@ class LMEngineModel(LMRuntimeModel):
         chunk_steps=8, prefix_cache_entries=0, prefix_cache_tokens=None,
         prefill_chunk=None, mesh=None, rules=None,
         kv_pool_tokens=None, page_size=64, pipeline_depth=1,
-        spec_draft_tokens=0, spec_ngram=3, **kwargs,
+        spec_draft_tokens=0, spec_ngram=3, watchdog=True,
+        watchdog_interval_s=0.5, watchdog_wedge_factor=8.0,
+        watchdog_min_wedge_s=30.0, **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
@@ -1724,6 +1964,13 @@ class LMEngineModel(LMRuntimeModel):
         )
         self.engine: LMEngine | None = None
         self._executor = None
+        #: engine watchdog (serve/watchdog.py): supervises this model's
+        #: engine slot, flips ``self.ready`` during restarts
+        self.watchdog = None
+        self._watchdog_on = watchdog
+        self._watchdog_interval = watchdog_interval_s
+        self._watchdog_factor = watchdog_wedge_factor
+        self._watchdog_min_wedge = watchdog_min_wedge_s
         # admission control happens HERE, on the caller's thread: the
         # private executor is sized max_batch, so without this check excess
         # requests would queue invisibly in the executor (never reaching
@@ -1731,19 +1978,12 @@ class LMEngineModel(LMRuntimeModel):
         self._inflight = 0
         self._inflight_lock = threading.Lock()
 
-    def load(self) -> bool:
-        super().load()  # restores params, device_put
-        # a PRIVATE executor for blocking engine.submit calls: the loop's
-        # default executor can be tiny (min(32, cpus+4) — 5 on a 1-cpu
-        # host) and shared; if other blocking work fills it, submits queue
-        # behind it and the server deadlocks while the engine sits idle
-        import concurrent.futures
-
-        self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=self._engine_max_batch,
-            thread_name_prefix=f"lm-engine-{self.name}",
-        )
-        self.engine = LMEngine(
+    def _make_engine(self) -> LMEngine:
+        """One engine instance from the stored knobs — load() builds the
+        first, the watchdog's supervised restart builds replacements
+        (fresh KV cache / pager / prefix cache / carry; params reused —
+        they are never donated, only the cache is)."""
+        return LMEngine(
             self._model, self.config, self._params,
             max_batch=self._engine_max_batch,
             max_seq=self._engine_max_seq,
@@ -1760,10 +2000,57 @@ class LMEngineModel(LMRuntimeModel):
             pipeline_depth=self._engine_pipeline_depth,
             spec_draft_tokens=self._engine_spec_draft,
             spec_ngram=self._engine_spec_ngram,
-        ).start()
+        )
+
+    def restart_engine(self, err: Exception | None = None) -> LMEngine:
+        """Tear down and rebuild the engine's device state. The watchdog's
+        rebuild hook; also callable directly by operators. The old engine
+        must already be poisoned/stopped — its wedged thread (if any) is
+        abandoned and exits on its own."""
+        self.engine = self._make_engine().start()
+        return self.engine
+
+    def _set_ready(self, ready: bool) -> None:
+        # the watchdog flips this first on a trip: /v2/health/ready goes
+        # 503 and the gateway's outlier ejection routes around the replica
+        self.ready = ready
+
+    def load(self) -> bool:
+        super().load()  # restores params, device_put
+        # a PRIVATE executor for blocking engine.submit calls: the loop's
+        # default executor can be tiny (min(32, cpus+4) — 5 on a 1-cpu
+        # host) and shared; if other blocking work fills it, submits queue
+        # behind it and the server deadlocks while the engine sits idle
+        import concurrent.futures
+
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._engine_max_batch,
+            thread_name_prefix=f"lm-engine-{self.name}",
+        )
+        self.engine = self._make_engine().start()
+        if self._watchdog_on:
+            from kubeflow_tpu.serve.watchdog import (
+                EngineWatchdog,
+                WatchdogConfig,
+            )
+
+            self.watchdog = EngineWatchdog(
+                lambda: self.engine,
+                self.restart_engine,
+                on_ready=self._set_ready,
+                config=WatchdogConfig(
+                    interval_s=self._watchdog_interval,
+                    wedge_factor=self._watchdog_factor,
+                    min_wedge_s=self._watchdog_min_wedge,
+                ),
+                model_name=self.name,
+            ).start()
         return True
 
     def unload(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
         if self.engine is not None:
             self.engine.stop()
             self.engine = None
@@ -1857,11 +2144,15 @@ class LMEngineModel(LMRuntimeModel):
         for key in eng.overlap:
             eng.overlap[key] = 0 if key == "carry_uploads" else 0.0
 
-    def _submit_row(self, row) -> dict:
+    def _submit_row(
+        self, row, deadline: float | None = None, priority: int = 0
+    ) -> dict:
         toks = self.engine.submit(
             row["ids"],
             max_new_tokens=self.max_new_tokens,
             temperature=row["temperature"],
+            deadline=deadline,
+            priority=priority,
         )
         return {"token_ids": toks}
 
@@ -1888,25 +2179,34 @@ class LMEngineModel(LMRuntimeModel):
         # rows still run would let new requests past the admission cap.
         import concurrent.futures as cf
 
+        deadline = deadline_from_headers(headers)
+        priority = priority_from_headers(headers)
         self._admit(len(rows))
-        futs = [self._executor.submit(self._submit_row, r) for r in rows]
+        futs = [
+            self._executor.submit(self._submit_row, r, deadline, priority)
+            for r in rows
+        ]
         try:
             cf.wait(futs)
         finally:
             self._release(len(rows))
         return [f.result() for f in futs]
 
-    def stream_row_tokens(self, row):
+    def stream_row_tokens(self, row, headers=None):
         """Token-chunk iterator for one preprocessed row — the server's
         generate_stream (SSE) hook. Admission happens EAGERLY (here, not at
         first next()) so overload raises before the server commits a 200;
         the wrapper guarantees release even for a stream that is closed
         before its first next() (a bare generator's finally wouldn't run)."""
+        deadline = deadline_from_headers(headers)
+        priority = priority_from_headers(headers)
         self._admit(1)
         gen = self.engine.stream(
             row["ids"],
             max_new_tokens=self.max_new_tokens,
             temperature=row["temperature"],
+            deadline=deadline,
+            priority=priority,
         )
         return _AdmittedStream(gen, lambda: self._release(1))
 
@@ -1914,6 +2214,8 @@ class LMEngineModel(LMRuntimeModel):
         import asyncio
 
         rows = self.preprocess(payload, headers)
+        deadline = deadline_from_headers(headers)
+        priority = priority_from_headers(headers)
         self._admit(len(rows))
         try:
             loop = asyncio.get_running_loop()
@@ -1922,7 +2224,10 @@ class LMEngineModel(LMRuntimeModel):
             # its siblings still occupy engine capacity
             outs = await asyncio.gather(
                 *[
-                    loop.run_in_executor(self._executor, self._submit_row, r)
+                    loop.run_in_executor(
+                        self._executor, self._submit_row, r, deadline,
+                        priority,
+                    )
                     for r in rows
                 ],
                 return_exceptions=True,

@@ -45,7 +45,11 @@ class RequestLogger:
                 "id": str(uuid.uuid4()),
                 "source": f"kubeflow-tpu/serve/{model}",
                 "type": event_type,
-                "time": time.time(),
+                # CloudEvents event stamps are wall-clock BY CONTRACT
+                # (consumers correlate them across hosts); this value is
+                # never subtracted from another stamp — all latency math
+                # in serve/ runs on monotonic/perf_counter clocks
+                "time": time.time(),  # kft: noqa[monotonic-clock] — CloudEvents wall-clock timestamp, never used in interval arithmetic
                 "inferenceserviceid": model,
                 "requestid": req_id,
                 "data": payload,

@@ -32,9 +32,41 @@ from aiohttp import web
 from kubeflow_tpu.obs import names, prom
 from kubeflow_tpu.serve import protocol
 from kubeflow_tpu.serve.batcher import Batcher, BatcherConfig
+from kubeflow_tpu.serve.deadline import (
+    DEADLINE_ABS_HEADER,
+    DEADLINE_EXPIRED,
+    AdmissionShed,
+    DeadlineExceeded,
+    deadline_from_headers,
+)
 from kubeflow_tpu.serve.engine import EngineOverloaded
 from kubeflow_tpu.serve.logger import RequestLogger
 from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.serve.watchdog import EngineRestarting
+
+
+def _shed_response(e: Exception) -> web.HTTPException | None:
+    """HTTP mapping for the SRE error taxonomy (serve/deadline.py).
+
+    Deadline-expired and admission-shed responses CARRY ``Retry-After`` —
+    the gateway's marker for "coherent load shed, do not retry/burn
+    budget". A watchdog restart is a bare 503: retryable, the gateway
+    should re-land the request on a healthy replica. Overload stays 429.
+    """
+    if isinstance(e, AdmissionShed):
+        return web.HTTPServiceUnavailable(
+            reason=str(e),
+            headers={"Retry-After": str(int(-(-e.retry_after_s // 1)))},
+        )
+    if isinstance(e, DeadlineExceeded):
+        return web.HTTPServiceUnavailable(
+            reason=str(e), headers={"Retry-After": "1"}
+        )
+    if isinstance(e, EngineRestarting):
+        return web.HTTPServiceUnavailable(reason=str(e))
+    if isinstance(e, EngineOverloaded):
+        return web.HTTPTooManyRequests(reason=str(e))
+    return None
 
 #: Batcher occupancy gauges (per model) on the process-wide registry, so the
 #: ObsServer's shared /metrics shows them next to the engine pool gauges;
@@ -133,12 +165,23 @@ def _batcher_collector(name: str, batcher: Batcher):
 
 
 class DataPlane:
-    """Model registry + request execution (the per-request hot path)."""
+    """Model registry + request execution (the per-request hot path).
 
-    def __init__(self, logger: RequestLogger | None = None):
+    ``default_deadline_ms`` is the KServe request-timeout analog: requests
+    arriving WITHOUT an ``x-kft-deadline-ms`` budget get this one, so a
+    replica never carries open-ended work (the old behavior was a
+    hardcoded 300 s engine timeout with no queue accounting)."""
+
+    def __init__(
+        self,
+        logger: RequestLogger | None = None,
+        *,
+        default_deadline_ms: float | None = None,
+    ):
         self._models: dict[str, Model] = {}
         self._batchers: dict[str, Batcher] = {}
         self.logger = logger
+        self.default_deadline_ms = default_deadline_ms
         self.metrics: dict[str, Any] = {"requests_total": {}, "latency_ms": {}}
         #: requests currently executing, per model — the load signal the
         #: gateway's least-outstanding balancer cross-checks, and what
@@ -199,6 +242,33 @@ class DataPlane:
 
     # -- execution ----------------------------------------------------------
 
+    def effective_headers(
+        self, headers: dict | None
+    ) -> tuple[dict, float | None]:
+        """Normalize the deadline contract ONCE at dataplane admission:
+        parse the wire budget (or apply the server default), stamp the
+        process-local absolute header so the batcher and engine charge
+        against the same clock edge, and fail already-expired requests
+        before they cost anything."""
+        headers = dict(headers or {})
+        # an absolute-deadline stamp arriving from a CLIENT is another
+        # process's monotonic clock (or a bypass attempt) — only this
+        # dataplane stamps it, so strip foreign ones before parsing
+        headers.pop(DEADLINE_ABS_HEADER, None)
+        headers.pop(DEADLINE_ABS_HEADER.title(), None)
+        deadline = deadline_from_headers(headers)
+        if deadline is None and self.default_deadline_ms is not None:
+            deadline = time.monotonic() + self.default_deadline_ms / 1e3
+        if deadline is not None:
+            headers[DEADLINE_ABS_HEADER] = repr(deadline)
+            if deadline - time.monotonic() <= 0:
+                DEADLINE_EXPIRED.labels(stage="admission").inc()
+                raise DeadlineExceeded(
+                    "deadline already expired at the dataplane",
+                    stage="admission",
+                )
+        return headers, deadline
+
     async def _predict_flat(self, model: Model, flat: list[Any]) -> list[Any]:
         x = model.preprocess({"instances": flat})
         y = model.predict(x)
@@ -224,7 +294,10 @@ class DataPlane:
             from kubeflow_tpu.serve.model import JAXModel
 
             payload = {"instances": JAXModel.payload_rows(payload)}
-        req_id = (headers or {}).get("x-request-id", str(uuid.uuid4()))
+        headers, deadline = self.effective_headers(headers)
+        req_id = headers.get("x-request-id") or headers.get(
+            "X-Request-Id", str(uuid.uuid4())
+        )
         if self.logger is not None:
             self.logger.log_request(name, req_id, payload)
         t0 = time.perf_counter()
@@ -232,7 +305,9 @@ class DataPlane:
         try:
             batcher = self._batchers.get(name)
             if batcher is not None and isinstance(payload, dict) and "instances" in payload:
-                preds = await batcher.submit(list(payload["instances"]))
+                preds = await batcher.submit(
+                    list(payload["instances"]), deadline=deadline
+                )
                 result: Any = {"predictions": preds}
             else:
                 result = await model(payload, headers)
@@ -267,6 +342,7 @@ class ModelServer:
         logger: RequestLogger | None = None,
         batcher: BatcherConfig | None = None,
         drain_grace_s: float = 10.0,
+        default_deadline_ms: float | None = None,
     ):
         self.http_port = http_port
         self.grpc_port = grpc_port
@@ -280,7 +356,9 @@ class ModelServer:
         from kubeflow_tpu.core.compcache import enable_compilation_cache
 
         enable_compilation_cache()
-        self.dataplane = DataPlane(logger=logger)
+        self.dataplane = DataPlane(
+            logger=logger, default_deadline_ms=default_deadline_ms
+        )
         self._batcher_cfg = batcher
         self._graphs: dict[str, Any] = {}  # name → InferenceGraph
         for m in models or []:
@@ -344,6 +422,11 @@ class ModelServer:
             out = await self._graphs[name].infer(payload)
         except ValueError as e:  # e.g. switch with no matching branch
             raise web.HTTPBadRequest(reason=str(e))
+        except Exception as e:
+            shed = _shed_response(e)
+            if shed is None:
+                raise
+            raise shed
         return web.json_response(out)
 
     async def _v2_generate(self, req: web.Request) -> web.Response:
@@ -363,8 +446,11 @@ class ModelServer:
             )
         except ValueError as e:  # same 400 contract as /infer and :predict
             raise web.HTTPBadRequest(reason=str(e))
-        except EngineOverloaded as e:
-            raise web.HTTPTooManyRequests(reason=str(e))
+        except Exception as e:
+            shed = _shed_response(e)
+            if shed is None:
+                raise
+            raise shed
         return web.json_response(result["predictions"][0])
 
     async def _v2_generate_stream(self, req: web.Request) -> web.StreamResponse:
@@ -391,7 +477,7 @@ class ModelServer:
         except Exception as e:
             raise web.HTTPBadRequest(reason=str(e))
         # streamed requests ride the same accounting as the DataPlane hot
-        # path — /metrics and the audit log must see them
+        # path — /metrics, the audit log, AND the deadline contract
         req_id = req.headers.get("x-request-id", str(uuid.uuid4()))
         if self.dataplane.logger is not None:
             self.dataplane.logger.log_request(
@@ -400,11 +486,16 @@ class ModelServer:
         t0 = time.perf_counter()
 
         try:
-            # admission is EAGER in stream_row_tokens: overload raises here,
-            # before any response bytes commit, and becomes a clean 429
-            gen = stream_rows(row)
-        except EngineOverloaded as e:
-            raise web.HTTPTooManyRequests(reason=str(e))
+            # admission is EAGER in stream_row_tokens: overload/shed raises
+            # here, before any response bytes commit, and becomes a clean
+            # 429 (overload) or 503 + Retry-After (deadline shed)
+            hdrs, _ = self.dataplane.effective_headers(dict(req.headers))
+            gen = stream_rows(row, hdrs)
+        except Exception as e:
+            shed = _shed_response(e)
+            if shed is None:
+                raise
+            raise shed
 
         resp = web.StreamResponse(
             headers={
@@ -494,8 +585,11 @@ class ModelServer:
             result = await self.dataplane.infer(name, body, dict(req.headers))
         except ValueError as e:
             raise web.HTTPBadRequest(reason=str(e))
-        except EngineOverloaded as e:
-            raise web.HTTPTooManyRequests(reason=str(e))
+        except Exception as e:
+            shed = _shed_response(e)
+            if shed is None:
+                raise
+            raise shed
         return web.json_response(protocol.encode_v1(result))
 
     async def _v1_explain(self, req: web.Request) -> web.Response:
@@ -545,6 +639,11 @@ class ModelServer:
             )
         except ValueError as e:
             raise web.HTTPBadRequest(reason=str(e))
+        except Exception as e:
+            shed = _shed_response(e)
+            if shed is None:
+                raise
+            raise shed
         preds = result["predictions"] if isinstance(result, dict) else result
         import numpy as np
 
@@ -661,6 +760,20 @@ class ModelServer:
                         f'{names.ENGINE_KV_PREFIX}{key}{{model="{name}"}} '
                         f"{val}"
                     )
+            # engine watchdog: trips by reason + supervised restarts (the
+            # smoke/chaos assertions read these per-replica, so they must
+            # be on THIS process's /metrics, not only the shared registry)
+            wd = getattr(model, "watchdog", None)
+            if wd is not None:
+                for reason, n in sorted(wd.stats["trips"].items()):
+                    lines.append(
+                        f'{names.ENGINE_WATCHDOG_TRIPS_TOTAL}'
+                        f'{{model="{name}",reason="{reason}"}} {n}'
+                    )
+                lines.append(
+                    f'{names.ENGINE_RESTARTS_TOTAL}{{model="{name}"}} '
+                    f'{wd.stats["restarts"]}'
+                )
         return web.Response(text="\n".join(lines) + "\n")
 
     # -- runtime ------------------------------------------------------------

@@ -772,7 +772,7 @@ def test_gateway_sse_client_disconnect_cancels_backend_row():
         def preprocess(self, payload, headers=None):
             return list(payload["instances"])
 
-        def stream_row_tokens(self, row):
+        def stream_row_tokens(self, row, headers=None):
             model = self
 
             def gen():
@@ -986,5 +986,314 @@ def test_dashboard_gateway_tab_api():
             assert svc["backends"][0]["url"] == "http://127.0.0.1:1"
         # no gateway attached → empty view, tab renders "none"
         assert DashboardServer(cluster=None).gateway_view() == {}
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------- serving SRE layer
+
+
+def test_gateway_shed_503_with_retry_after_is_not_retried():
+    """The retry classifier: a 503 CARRYING Retry-After is a coherent
+    load shed (deadline/admission) — passed through to the client with
+    zero retries, zero breaker penalty. A bare 503 stays retryable."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    async def run():
+        calls = {"shed": 0, "broken": 0, "ok": 0}
+
+        async def mk(kind):
+            async def ready(request):
+                return web.json_response({"ready": True})
+
+            async def predict(request):
+                calls[kind] += 1
+                if kind == "shed":
+                    return web.json_response(
+                        {"error": "deadline unmeetable"},
+                        status=503, headers={"Retry-After": "7"},
+                    )
+                if kind == "broken":
+                    return web.json_response({"error": "dying"}, status=503)
+                return web.json_response({"predictions": [kind]})
+
+            app = web.Application()
+            app.router.add_get("/v2/health/ready", ready)
+            app.router.add_post("/v1/models/m:predict", predict)
+            srv = TestServer(app)
+            await srv.start_server()
+            return srv, f"http://127.0.0.1:{srv.port}"
+
+        # shed-only service: the 503 must come straight back
+        srv_shed, url_shed = await mk("shed")
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, retry_budget_floor=50,
+            backends=[("m", url_shed, "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            r0 = _metric("kft_gateway_retries_total", service="m")
+            r = await client.post("/v1/models/m:predict",
+                                  json={"instances": [[1]]})
+            assert r.status == 503
+            assert r.headers.get("Retry-After") == "7"
+            assert calls["shed"] == 1  # exactly one attempt: no retry
+            assert _metric("kft_gateway_retries_total", service="m") == r0
+            assert _metric("kft_gateway_shed_total",
+                           service="m", reason="upstream_shed") >= 1
+            # the shed did NOT poison the breaker
+            (b,) = gw.pool.backends_of("m")
+            assert b.breaker.current_state() == "closed"
+        finally:
+            await client.close()
+            await srv_shed.close()
+
+        # broken + healthy pair: the bare 503 IS retried to the survivor
+        srv_broken, url_broken = await mk("broken")
+        srv_ok, url_ok = await mk("ok")
+        gw2 = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, retry_budget_floor=50,
+            backends=[("m", url_broken, "default"),
+                      ("m", url_ok, "default")],
+        ))
+        client2 = await _gateway_client(gw2)
+        try:
+            for i in range(4):
+                r = await client2.post("/v1/models/m:predict",
+                                       json={"instances": [[i]]})
+                assert r.status == 200, await r.text()
+            assert calls["ok"] >= 4 and calls["broken"] >= 1
+        finally:
+            await client2.close()
+            await srv_broken.close()
+            await srv_ok.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_deadline_expiry_shed_at_edge_and_budget_rewrite():
+    """A request whose x-kft-deadline-ms budget is already spent sheds AT
+    THE EDGE (503 + Retry-After, reason=deadline); a live budget is
+    rewritten to the remaining milliseconds before each dispatch."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    async def run():
+        seen_budgets = []
+
+        async def ready(request):
+            return web.json_response({"ready": True})
+
+        async def predict(request):
+            seen_budgets.append(
+                request.headers.get("x-kft-deadline-ms")
+            )
+            assert "x-kft-deadline-abs" not in request.headers
+            return web.json_response({"predictions": ["ok"]})
+
+        app = web.Application()
+        app.router.add_get("/v2/health/ready", ready)
+        app.router.add_post("/v1/models/m:predict", predict)
+        srv = TestServer(app)
+        await srv.start_server()
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0,
+            backends=[("m", f"http://127.0.0.1:{srv.port}", "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            d0 = _metric("kft_gateway_shed_total",
+                         service="m", reason="deadline")
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[1]]},
+                headers={"x-kft-deadline-ms": "0"},
+            )
+            assert r.status == 503
+            assert r.headers.get("Retry-After") == "1"
+            assert _metric("kft_gateway_shed_total",
+                           service="m", reason="deadline") == d0 + 1
+            assert seen_budgets == []  # never dispatched upstream
+            # a live budget reaches the backend REWRITTEN to what's left
+            # (and the process-local absolute header never crosses)
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[1]]},
+                headers={"x-kft-deadline-ms": "60000",
+                         "x-kft-deadline-abs": "12345.0"},
+            )
+            assert r.status == 200
+            assert len(seen_budgets) == 1
+            assert 0 < int(seen_budgets[0]) <= 60000
+        finally:
+            await client.close()
+            await srv.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_stamps_tenant_priority_for_managed_tenants():
+    """The gateway is authoritative for managed tenants' shed priority:
+    x-kft-priority is stamped from TenantPolicy and a client cannot
+    self-promote; unmanaged tenants pass through untouched."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    from kubeflow_tpu.gateway.policy import TenantPolicy
+
+    async def run():
+        seen = []
+
+        async def ready(request):
+            return web.json_response({"ready": True})
+
+        async def predict(request):
+            seen.append(request.headers.get("x-kft-priority"))
+            return web.json_response({"predictions": ["ok"]})
+
+        app = web.Application()
+        app.router.add_get("/v2/health/ready", ready)
+        app.router.add_post("/v1/models/m:predict", predict)
+        srv = TestServer(app)
+        await srv.start_server()
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0,
+            backends=[("m", f"http://127.0.0.1:{srv.port}", "default")],
+        ))
+        gw.policy.set("gold", TenantPolicy(priority=9))
+        client = await _gateway_client(gw)
+        try:
+            # managed tenant: stamped, client's self-promotion overwritten
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[1]]},
+                headers={"x-kft-tenant": "gold", "x-kft-priority": "99"},
+            )
+            assert r.status == 200
+            # unmanaged tenant: client header passes through
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[1]]},
+                headers={"x-kft-tenant": "stranger", "x-kft-priority": "3"},
+            )
+            assert r.status == 200
+            assert seen == ["9", "3"]
+        finally:
+            await client.close()
+            await srv.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_wedged_engine_behind_gateway_watchdog_restart_zero_failures():
+    """THE acceptance e2e: two engine-backed replicas behind the gateway;
+    WedgeEngine stalls one mid-burst → its watchdog trips within budget,
+    fails in-flight work retryably (gateway re-lands it on the healthy
+    replica), rebuilds the engine, and restores readiness — 100% of
+    non-shed client requests succeed. A deadline-bearing request queued
+    past its budget sheds with 503 + Retry-After without consuming a
+    decode slot on either replica."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiohttp.test_utils import TestServer
+
+    from kubeflow_tpu.chaos.injectors import wedge_engine
+    from kubeflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from kubeflow_tpu.serve.engine import LMEngineModel
+    from kubeflow_tpu.serve.model import BucketSpec
+
+    cfg = TransformerConfig(
+        vocab_size=89, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        causal=True, max_seq_len=256, attn_impl="reference",
+        dtype=jnp.float32,
+    )
+    tlm = TransformerLM(cfg)
+    params = tlm.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    def replica():
+        m = LMEngineModel(
+            "m", None, config=cfg, max_batch=4, chunk_steps=2,
+            buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+            max_new_tokens=6, eos_id=1,
+            watchdog_interval_s=0.1, watchdog_min_wedge_s=60.0,
+        )
+        m.load()
+        m._params = jax.device_put(params)
+        m.engine.stop()
+        m.engine = m._make_engine().start()
+        return m
+
+    async def run():
+        m_a, m_b = replica(), replica()
+        ms_a, ms_b = ModelServer([m_a]), ModelServer([m_b])
+        srv_a, srv_b = TestServer(ms_a.build_app()), TestServer(ms_b.build_app())
+        await srv_a.start_server()
+        await srv_b.start_server()
+        url_a = f"http://127.0.0.1:{srv_a.port}"
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=0.25, probe_timeout_s=1.0,
+            eject_threshold=1, failure_threshold=2, recovery_s=60.0,
+            retry_budget_floor=100,
+            routes=[ServiceRoute(name="m", max_attempts=4)],
+            backends=[("m", url_a, "default"),
+                      ("m", f"http://127.0.0.1:{srv_b.port}", "default")],
+        ))
+        client = await _gateway_client(gw)
+        release = None
+        try:
+            async def one(i, headers=None):
+                r = await client.post(
+                    "/v1/models/m:predict",
+                    json={"instances": [{"input_ids": [3 + i % 5, 4, 5]}]},
+                    headers=headers or {},
+                )
+                return r.status, r.headers.get("Retry-After"), await r.text()
+
+            # warm both replicas through their compiles
+            for i in range(6):
+                status, _, body = await one(i)
+                assert status == 200, body
+            # tighten the wedge trip point now that the EWMA is warm
+            for m in (m_a, m_b):
+                m.watchdog.config.min_wedge_s = 1.0
+
+            trips0 = _metric("kft_engine_watchdog_trips_total",
+                             model="m", reason="wedged")
+            restarts0 = _metric("kft_engine_restarts_total", model="m")
+            retries0 = _metric("kft_gateway_retries_total", service="m")
+
+            release = wedge_engine(m_a.engine, hold_s=45.0)
+            results = await asyncio.gather(*[one(100 + i) for i in range(16)])
+            statuses = [s for s, _, _ in results]
+            assert statuses == [200] * 16, results
+            assert _metric("kft_engine_watchdog_trips_total",
+                           model="m", reason="wedged") >= trips0 + 1
+            assert _metric("kft_engine_restarts_total",
+                           model="m") >= restarts0 + 1
+            assert _metric("kft_gateway_retries_total",
+                           service="m") > retries0
+            assert m_a.ready and m_b.ready  # replica A recovered
+
+            # the correctly-shed tail: an already-expired budget is 503 +
+            # Retry-After at the edge and costs NEITHER engine a slot
+            admitted = (m_a.engine.stats["admitted"],
+                        m_b.engine.stats["admitted"])
+            status, retry_after, _ = await one(999,
+                                               {"x-kft-deadline-ms": "0"})
+            assert status == 503 and retry_after == "1"
+            assert (m_a.engine.stats["admitted"],
+                    m_b.engine.stats["admitted"]) == admitted
+        finally:
+            if release is not None:
+                release()
+            await client.close()
+            m_a.unload()
+            m_b.unload()
+            await srv_a.close()
+            await srv_b.close()
 
     asyncio.run(run())
