@@ -1,0 +1,47 @@
+"""Serving autoscaler (Knative-KPA analog) + cross-replica prefix-KV
+transfer — the control loop that makes the horizontal serving plane
+(gateway + activator + ModelServer replicas) actually follow load.
+
+- :mod:`kpa` — the pure recommender: stable/panic windows over a
+  per-service concurrency target, scale-to-zero grace, rate limits;
+- :mod:`signals` — scrape + fold the autoscaler inputs
+  (``kft_server_inflight``, queue depths, activator parking);
+- :mod:`autoscaler` — the event-loop control loop wiring recommenders to
+  actuators, kicked out-of-band by the activator's cold episodes;
+- :mod:`fleet` — the production actuator: replica lifecycle + gateway
+  pool membership + prefix-KV rebalance around every remap;
+- :mod:`kv_transfer` — plan/execute pulls of stored prefix KV from the
+  previous ring owner to the new one.
+"""
+
+from kubeflow_tpu.autoscale.autoscaler import ServingAutoscaler, TickResult
+from kubeflow_tpu.autoscale.fleet import ReplicaFleet, subprocess_launcher
+from kubeflow_tpu.autoscale.kpa import KPAConfig, KPARecommender, Recommendation
+from kubeflow_tpu.autoscale.kv_transfer import (
+    Transfer,
+    owner_of,
+    plan_rebalance,
+    rebalance,
+)
+from kubeflow_tpu.autoscale.signals import (
+    GatewaySignalSource,
+    ServiceSignals,
+    parse_prom_text,
+)
+
+__all__ = [
+    "GatewaySignalSource",
+    "KPAConfig",
+    "KPARecommender",
+    "Recommendation",
+    "ReplicaFleet",
+    "ServiceSignals",
+    "ServingAutoscaler",
+    "TickResult",
+    "Transfer",
+    "owner_of",
+    "parse_prom_text",
+    "plan_rebalance",
+    "rebalance",
+    "subprocess_launcher",
+]

@@ -1,0 +1,243 @@
+"""The serving autoscaler: close the loop from live signals to replicas.
+
+Knative-KPA analog, colocated with the gateway the way Knative colocates
+the autoscaler with the activator's stat stream:
+
+    signals (replica /metrics + activator depth)
+        → KPARecommender (stable/panic windows over the concurrency target)
+        → actuator (ReplicaFleet launches/drains replicas, or the
+          InferenceServiceController's replica sets)
+
+Event-loop confined like the rest of the gateway — no threads, no locks
+beyond per-service asyncio serialization. The activator's cold-episode
+``scale_up`` kick is wired to :meth:`kick`, which marks demand and runs
+an immediate out-of-band tick so scale-from-zero does not wait out a
+tick interval while a client sits parked.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Any, Awaitable, Callable
+
+from kubeflow_tpu.autoscale.kpa import KPAConfig, KPARecommender
+from kubeflow_tpu.autoscale.signals import ServiceSignals
+from kubeflow_tpu.obs import names, prom
+
+logger = logging.getLogger(__name__)
+
+DESIRED = prom.REGISTRY.gauge(
+    names.AUTOSCALER_DESIRED_REPLICAS,
+    "recommender's current desired replica count",
+    ("service",),
+)
+STABLE_CONCURRENCY = prom.REGISTRY.gauge(
+    names.AUTOSCALER_STABLE_CONCURRENCY,
+    "stable-window average observed concurrency",
+    ("service",),
+)
+PANIC_CONCURRENCY = prom.REGISTRY.gauge(
+    names.AUTOSCALER_PANIC_CONCURRENCY,
+    "panic-window average observed concurrency",
+    ("service",),
+)
+PANIC_MODE = prom.REGISTRY.gauge(
+    names.AUTOSCALER_PANIC_MODE,
+    "1 while the service is in panic mode (scale-down frozen)",
+    ("service",),
+)
+SCALE_EVENTS = prom.REGISTRY.counter(
+    names.AUTOSCALER_SCALE_EVENTS_TOTAL,
+    "actuated replica-count changes",
+    ("service", "direction"),
+)
+
+
+class _ServiceState:
+    def __init__(
+        self,
+        name: str,
+        config: KPAConfig,
+        signals,
+        actuator,
+        clock,
+    ):
+        self.name = name
+        self.signals = signals
+        self.actuator = actuator
+        self.recommender = KPARecommender(config, clock=clock)
+        #: serializes ticks per service: a kick-triggered tick and the
+        #: interval tick must not actuate the same service concurrently
+        self.lock = asyncio.Lock()
+        self.last: ServiceSignals | None = None
+        self.last_recommendation = None
+
+
+@dataclasses.dataclass
+class TickResult:
+    service: str
+    desired: int
+    current: int
+    concurrency: float
+    panic: bool
+
+
+class ServingAutoscaler:
+    """Owns one recommender per service and drives their actuators.
+
+    ``signals`` is an async callable → :class:`ServiceSignals`;
+    ``actuator`` exposes ``current() -> int`` and
+    ``async scale_to(n) -> None`` (autoscale/fleet.py ReplicaFleet is the
+    production one). ``clock`` is injectable for fake-clock tests."""
+
+    def __init__(
+        self,
+        *,
+        tick_interval_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.tick_interval_s = tick_interval_s
+        self._clock = clock
+        self._services: dict[str, _ServiceState] = {}
+        self._task: asyncio.Task | None = None
+
+    def add_service(
+        self,
+        name: str,
+        config: KPAConfig,
+        signals: Callable[[], Awaitable[ServiceSignals]],
+        actuator: Any,
+    ) -> None:
+        self._services[name] = _ServiceState(
+            name, config, signals, actuator, self._clock
+        )
+
+    def services(self) -> list[str]:
+        return sorted(self._services)
+
+    # -- the control loop ------------------------------------------------ #
+
+    def kick(self, service: str) -> None:
+        """The activator's cold-episode scale-up hook: mark demand and
+        tick NOW (a parked client should not wait out the interval).
+        Called from the gateway's event loop; safe to call for unknown
+        services (the activator may front services we do not scale)."""
+        st = self._services.get(service)
+        if st is None:
+            return
+        st.recommender.activity()
+        asyncio.ensure_future(self.tick_service(service))
+
+    async def tick_service(
+        self, service: str, now: float | None = None
+    ) -> TickResult | None:
+        st = self._services.get(service)
+        if st is None:
+            return None
+        async with st.lock:
+            now = self._clock() if now is None else now
+            try:
+                sig = await st.signals()
+            except Exception:  # noqa: BLE001 — a scrape must not kill the loop
+                logger.exception("autoscaler: signal scrape failed for %s",
+                                 service)
+                return None
+            st.last = sig
+            st.recommender.observe(sig.concurrency, now=now)
+            current = int(st.actuator.current())
+            rec = st.recommender.recommend(current, now=now)
+            st.last_recommendation = rec
+            DESIRED.labels(service=service).set(rec.desired)
+            STABLE_CONCURRENCY.labels(service=service).set(
+                rec.stable_concurrency
+            )
+            PANIC_CONCURRENCY.labels(service=service).set(
+                rec.panic_concurrency
+            )
+            PANIC_MODE.labels(service=service).set(1 if rec.panic else 0)
+            if rec.desired != current:
+                direction = "up" if rec.desired > current else "down"
+                SCALE_EVENTS.labels(
+                    service=service, direction=direction
+                ).inc()
+                logger.warning(
+                    "autoscaler: %s %s %d -> %d (concurrency=%.2f "
+                    "stable=%.2f panic=%.2f%s)",
+                    service, direction, current, rec.desired,
+                    sig.concurrency, rec.stable_concurrency,
+                    rec.panic_concurrency, " PANIC" if rec.panic else "",
+                )
+                try:
+                    await st.actuator.scale_to(rec.desired)
+                except Exception:  # noqa: BLE001 — retried next tick
+                    logger.exception(
+                        "autoscaler: scale_to(%d) failed for %s",
+                        rec.desired, service,
+                    )
+            return TickResult(
+                service=service,
+                desired=rec.desired,
+                current=current,
+                concurrency=sig.concurrency,
+                panic=rec.panic,
+            )
+
+    async def tick(self, now: float | None = None) -> list[TickResult]:
+        out = []
+        for name in self.services():
+            r = await self.tick_service(name, now=now)
+            if r is not None:
+                out.append(r)
+        return out
+
+    async def run(self) -> None:
+        """The interval loop (cancel to stop) — `start()`/`stop()` wrap it
+        as a task on the running loop."""
+        while True:
+            await self.tick()
+            await asyncio.sleep(self.tick_interval_s)
+
+    def start(self) -> "ServingAutoscaler":
+        if self._task is None:
+            self._task = asyncio.ensure_future(self.run())
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # -- introspection (dashboard /api/autoscaler) ----------------------- #
+
+    def view(self) -> dict:
+        out = {}
+        for name, st in sorted(self._services.items()):
+            rec = st.last_recommendation
+            cfg = st.recommender.config
+            out[name] = {
+                "config": {
+                    "target": cfg.target,
+                    "min_replicas": cfg.min_replicas,
+                    "max_replicas": cfg.max_replicas,
+                    "stable_window_s": cfg.stable_window_s,
+                    "panic_window_s": cfg.panic_window_s,
+                    "panic_threshold": cfg.panic_threshold,
+                    "scale_to_zero_grace_s": cfg.scale_to_zero_grace_s,
+                },
+                "current": int(st.actuator.current()),
+                "desired": rec.desired if rec else None,
+                "panic": bool(rec.panic) if rec else False,
+                "stable_concurrency": (
+                    rec.stable_concurrency if rec else 0.0
+                ),
+                "panic_concurrency": rec.panic_concurrency if rec else 0.0,
+                "signals": dataclasses.asdict(st.last) if st.last else None,
+            }
+        return out

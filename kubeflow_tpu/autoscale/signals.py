@@ -1,0 +1,183 @@
+"""Autoscaler input signals: scrape and fold replica + gateway metrics.
+
+The recommender (autoscale/kpa.py) wants ONE number per service — the
+observed concurrency — but that number lives in three places:
+
+- each replica's ``/metrics``: ``kft_server_inflight{model}`` (requests
+  executing in the dataplane, SSE streams included) and
+  ``kft_server_queue_depth{model}`` (batcher backlog);
+- the gateway's activator: requests parked because zero backends are
+  ready — demand that MUST count, or scale-from-zero never triggers;
+- the replica's engine: ``kft_engine_decode_gap_ms`` (chunk cadence),
+  scraped alongside for operator visibility.
+
+``parse_prom_text`` is a minimal Prometheus text-format reader for the
+first-party expositions this repo emits (no exemplars, no escapes beyond
+the ones ``obs/prom.py`` writes)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Mapping
+
+from kubeflow_tpu.obs import names
+
+_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)\s*$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prom_text(
+    text: str,
+) -> dict[str, list[tuple[dict[str, str], float]]]:
+    """→ ``{metric_name: [(labels, value), ...]}``. Unparseable lines and
+    comments are skipped (a scrape must degrade, not raise)."""
+    out: dict[str, list[tuple[dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE_RE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = {
+            k: v.replace('\\"', '"').replace("\\\\", "\\")
+            for k, v in _LABEL_RE.findall(m.group("labels") or "")
+        }
+        out.setdefault(m.group("name"), []).append((labels, value))
+    return out
+
+
+def metric_sum(
+    parsed: Mapping[str, list[tuple[dict[str, str], float]]],
+    name: str,
+    **match: str,
+) -> float:
+    """Sum of every sample of ``name`` whose labels include ``match``."""
+    total = 0.0
+    for labels, value in parsed.get(name, ()):
+        if all(labels.get(k) == v for k, v in match.items()):
+            total += value
+    return total
+
+
+def metric_max(
+    parsed: Mapping[str, list[tuple[dict[str, str], float]]],
+    name: str,
+    **match: str,
+) -> float:
+    best = 0.0
+    for labels, value in parsed.get(name, ()):
+        if all(labels.get(k) == v for k, v in match.items()):
+            best = max(best, value)
+    return best
+
+
+@dataclasses.dataclass
+class ServiceSignals:
+    """One tick's folded view of a service's load."""
+
+    #: sum of kft_server_inflight across reporting replicas
+    inflight: float = 0.0
+    #: sum of kft_server_queue_depth across reporting replicas
+    queue_depth: float = 0.0
+    #: requests parked in the gateway activator right now
+    activator_depth: float = 0.0
+    #: max kft_engine_decode_gap_ms across replicas (cadence telemetry)
+    decode_gap_ms: float = 0.0
+    #: replicas whose /metrics answered this tick
+    replicas_reporting: int = 0
+
+    @property
+    def concurrency(self) -> float:
+        """The KPA input: demand anywhere in the path counts."""
+        return self.inflight + self.queue_depth + self.activator_depth
+
+
+def fold_replica_metrics(
+    signals: ServiceSignals,
+    parsed: Mapping[str, list[tuple[dict[str, str], float]]],
+) -> None:
+    """Fold one replica's parsed ``/metrics`` into the tick's signals.
+    The names are the obs/names.py constants — the single definition
+    site, so a rename cannot silently blind the autoscaler."""
+    signals.inflight += metric_sum(parsed, names.SERVER_INFLIGHT)
+    signals.queue_depth += metric_sum(parsed, names.SERVER_QUEUE_DEPTH)
+    signals.decode_gap_ms = max(
+        signals.decode_gap_ms, metric_max(parsed, names.ENGINE_DECODE_GAP_MS)
+    )
+    signals.replicas_reporting += 1
+
+
+class GatewaySignalSource:
+    """Async signal source for an autoscaler colocated with the gateway:
+    scrapes every active backend's ``/metrics`` over HTTP and reads the
+    activator queue depth in-process. Unreachable replicas contribute
+    nothing (a dead replica must not freeze the signal at its last
+    value — the probe loop will eject it)."""
+
+    def __init__(
+        self,
+        gateway: Any,
+        service: str,
+        *,
+        session: Any = None,
+        timeout_s: float = 5.0,
+    ):
+        self.gateway = gateway
+        self.service = service
+        self._session = session
+        self.timeout_s = timeout_s
+
+    async def _get_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def __call__(self) -> ServiceSignals:
+        import asyncio
+
+        import aiohttp
+
+        signals = ServiceSignals(
+            activator_depth=float(self.gateway.activator.depth(self.service))
+        )
+        backends = [
+            b
+            for b in self.gateway.pool.backends_of(self.service)
+            if b.state == "active"
+        ]
+        if not backends:
+            return signals
+        session = await self._get_session()
+
+        async def scrape(url: str) -> None:
+            try:
+                async with session.get(
+                    f"{url}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=self.timeout_s),
+                ) as resp:
+                    if resp.status != 200:
+                        return
+                    fold_replica_metrics(
+                        signals, parse_prom_text(await resp.text())
+                    )
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                return  # unreachable replica: contributes nothing
+
+        await asyncio.gather(*[scrape(b.url) for b in backends])
+        return signals

@@ -11,6 +11,7 @@ a plan against a job on a ``LocalCluster`` while measuring recovery
 
 from kubeflow_tpu.chaos.injectors import (  # noqa: F401
     corrupt_checkpoint,
+    drop_prefix_cache,
     kill_backend,
     record_injection,
     resume_backend,
@@ -19,6 +20,7 @@ from kubeflow_tpu.chaos.injectors import (  # noqa: F401
 from kubeflow_tpu.chaos.plan import (  # noqa: F401
     CorruptCheckpoint,
     CrashWorker,
+    DropPrefixCache,
     DropSlice,
     Fault,
     FaultPlan,

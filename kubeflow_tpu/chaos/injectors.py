@@ -160,6 +160,19 @@ def wedge_engine(engine, *, hold_s: float = 30.0):
     return released.set
 
 
+def drop_prefix_cache(engine) -> int:
+    """Wipe the engine's stored prefix KV — the cold-cache state a fresh
+    replica (or a ring remap victim) starts in. Production seam:
+    ``LMEngine.drop_prefix_cache`` (lock-guarded against the scheduler
+    thread and the peer-transfer endpoints). Returns entries dropped."""
+    record_injection("drop_prefix_cache")
+    dropped = engine.drop_prefix_cache()
+    logger.warning(
+        "chaos: dropped %d prefix-cache entries (replica is cold)", dropped
+    )
+    return dropped
+
+
 def slow_decode(engine, *, delay_s: float = 0.05):
     """Inflate every chunk's latency by ``delay_s`` — the brownout (not
     blackout) fault: decode throughput collapses, queue-wait estimates

@@ -118,6 +118,17 @@ class SlowDecode(Fault):
 
 
 @dataclasses.dataclass(frozen=True)
+class DropPrefixCache(Fault):
+    """Serving fault: wipe the named model's engine prefix cache — the
+    cold-cache state a freshly scaled replica starts in. The recovery
+    path under test is the autoscale plane's cross-replica KV transfer
+    (``prefix_cache:pull`` from a warm peer) and, failing that, plain
+    re-prefill; either way the token streams must be unchanged."""
+
+    model: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class CorruptCheckpoint(Fault):
     """Silently flip one byte in the newest checkpoint step under
     ``directory`` (or an explicit ``step``) — the bit-rot/torn-copy case
@@ -131,7 +142,7 @@ class CorruptCheckpoint(Fault):
 FAULT_KINDS = {
     c.__name__: c
     for c in (CrashWorker, PreemptWorker, WedgeWorker, DropSlice,
-              WedgeEngine, SlowDecode, CorruptCheckpoint)
+              WedgeEngine, SlowDecode, DropPrefixCache, CorruptCheckpoint)
 }
 
 

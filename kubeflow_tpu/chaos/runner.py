@@ -32,6 +32,7 @@ from kubeflow_tpu.chaos.plan import (
     Fault,
     FaultPlan,
     PreemptWorker,
+    DropPrefixCache,
     SlowDecode,
     WedgeEngine,
     WedgeWorker,
@@ -39,7 +40,7 @@ from kubeflow_tpu.chaos.plan import (
 
 #: serving fault kinds: target an LMEngine resolved by model name via the
 #: runner's ``engines`` mapping, not a training worker process
-_SERVING_FAULTS = (WedgeEngine, SlowDecode)
+_SERVING_FAULTS = (WedgeEngine, SlowDecode, DropPrefixCache)
 from kubeflow_tpu.obs import heartbeat as hb
 from kubeflow_tpu.orchestrator.spec import WorkerPhase, WorkerStatus
 
@@ -159,6 +160,8 @@ class ChaosRunner:
             engine = self.engines[fault.model]
             if isinstance(fault, WedgeEngine):
                 injectors.wedge_engine(engine, hold_s=fault.hold_s)
+            elif isinstance(fault, DropPrefixCache):
+                injectors.drop_prefix_cache(engine)
             else:
                 injectors.slow_decode(engine, delay_s=fault.delay_s)
             logger.warning(

@@ -25,7 +25,10 @@ Subcommands:
   registry, serve REST (+ optional gRPC) until SIGINT.
 - ``kft gateway run -f <path>`` — run the L7 inference gateway from an
   ``InferenceGateway`` manifest: health-probed backend pools, edge canary
-  split, activator buffering, per-tenant policy, /metrics.
+  split, activator buffering, per-tenant policy, /metrics; services with
+  an ``autoscaling:`` section get a colocated KPA-style autoscaler that
+  launches/drains ``replicaCommand`` subprocess replicas to follow load
+  (scale-to-zero through the activator, prefix-KV transfer on remap).
 - ``kft models``       — model registry verbs (list/show/register/promote/
   rollback/lineage) over the store at ``--root``/``KFT_REGISTRY_ROOT``.
 - ``kft chaos run``    — run Job manifests under a declarative FaultPlan
@@ -327,6 +330,52 @@ def _cmd_gateway(args) -> int:
 
     async def main() -> None:
         await gw.start_async()
+        # per-service autoscaling: a ServingAutoscaler + subprocess
+        # ReplicaFleet per `autoscaling:` manifest section, colocated
+        # with the gateway (the Knative autoscaler/activator layout) —
+        # the activator's cold-episode kick ticks it out-of-band
+        autoscaler = None
+        fleets = []
+        sources = []
+        if config.autoscaling:
+            from kubeflow_tpu.autoscale import (
+                GatewaySignalSource,
+                KPAConfig,
+                ReplicaFleet,
+                ServingAutoscaler,
+                subprocess_launcher,
+            )
+
+            autoscaler = ServingAutoscaler(
+                tick_interval_s=float(
+                    next(iter(config.autoscaling.values())).get(
+                        "tickIntervalS", 1.0
+                    )
+                )
+            )
+            for svc, auto in config.autoscaling.items():
+                kpa = KPAConfig.from_manifest(auto)
+                fleet = ReplicaFleet(
+                    svc,
+                    subprocess_launcher(list(auto["replicaCommand"])),
+                    pool=gw.pool,
+                    model=auto.get("model", svc),
+                    transfer_prefix_kv=bool(
+                        auto.get("transferPrefixKV", True)
+                    ),
+                )
+                fleets.append(fleet)
+                source = GatewaySignalSource(gw, svc)
+                sources.append(source)
+                autoscaler.add_service(svc, kpa, source, fleet)
+                await fleet.scale_to(max(kpa.min_replicas, 0))
+                print(
+                    f"autoscaler/{svc}: target={kpa.target} replicas="
+                    f"[{kpa.min_replicas},{kpa.max_replicas}] "
+                    f"initial={fleet.current()}"
+                )
+            gw.activator.scale_up = autoscaler.kick
+            autoscaler.start()
         print(f"gateway on http://127.0.0.1:{gw.http_port}", flush=True)
         if args.port_file:
             with open(args.port_file, "w") as f:
@@ -335,6 +384,12 @@ def _cmd_gateway(args) -> int:
             while True:
                 await asyncio.sleep(3600)
         finally:
+            if autoscaler is not None:
+                await autoscaler.stop()
+            for source in sources:
+                await source.close()
+            for fleet in fleets:
+                await fleet.close()
             await gw.stop_async()
 
     try:

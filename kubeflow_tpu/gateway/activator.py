@@ -33,6 +33,18 @@ QUEUE_DEPTH = prom.REGISTRY.gauge(
     "requests parked in the activator FIFO",
     ("service",),
 )
+#: the same depth under its autoscaler-facing name: parked demand counts
+#: as concurrency (autoscale/signals.py), or scale-from-zero never fires
+ACTIVATOR_QUEUE_DEPTH = prom.REGISTRY.gauge(
+    names.GATEWAY_ACTIVATOR_QUEUE_DEPTH,
+    "autoscaler input: requests parked in the activator FIFO",
+    ("service",),
+)
+COLD_EPISODE = prom.REGISTRY.gauge(
+    names.GATEWAY_ACTIVATOR_COLD_EPISODE,
+    "1 while a cold-episode scale-up kick is outstanding",
+    ("service",),
+)
 ACTIVATIONS = prom.REGISTRY.counter(
     names.GATEWAY_ACTIVATIONS_TOTAL,
     "scale-from-zero kicks issued by the activator",
@@ -80,9 +92,11 @@ class Activator:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         q.append(fut)
         QUEUE_DEPTH.labels(service=service).set(len(q))
+        ACTIVATOR_QUEUE_DEPTH.labels(service=service).set(len(q))
         if service not in self._kicked and self.scale_up is not None:
             self._kicked[service] = self._clock()
             ACTIVATIONS.labels(service=service).inc()
+            COLD_EPISODE.labels(service=service).set(1)
             try:
                 self.scale_up(service)
             except Exception:  # noqa: BLE001 — a failed kick must not kill
@@ -99,12 +113,14 @@ class Activator:
             if fut in q:
                 q.remove(fut)
             QUEUE_DEPTH.labels(service=service).set(len(q))
+            ACTIVATOR_QUEUE_DEPTH.labels(service=service).set(len(q))
 
     def notify(self, service: str) -> None:
         """A backend for ``service`` is ready: wake every parked waiter in
         admission (FIFO) order. Waiters re-select a backend themselves —
         the first may consume capacity, later ones may re-park."""
         self._kicked.pop(service, None)
+        COLD_EPISODE.labels(service=service).set(0)
         q = self._parked.get(service)
         if not q:
             return

@@ -204,6 +204,21 @@ class RouteTable:
 # -- affinity keys ------------------------------------------------------- #
 
 
+def prefix_affinity_key(tokens, n: int = 16) -> str:
+    """The ring key for a token-id prompt prefix. ONE definition shared by
+    the edge (``affinity_key_of``) and the prefix-KV transfer planner
+    (autoscale/kv_transfer.py): both must hash an engine prefix-cache
+    entry to the same replica, or transfers land where traffic won't."""
+
+    def norm(t) -> str:
+        try:  # "3", 3, 3.0 → "3"; non-numeric tokens key as themselves
+            return str(int(t))
+        except (TypeError, ValueError):
+            return str(t)
+
+    return "prefix:" + ",".join(norm(t) for t in list(tokens)[:n])
+
+
 def affinity_key_of(
     route: ServiceRoute,
     headers: Mapping[str, str],
@@ -239,7 +254,6 @@ def affinity_key_of(
         return None
     n = route.affinity_prefix_tokens
     if isinstance(prefix, str):
-        head = prefix[: n * 4]  # ~chars per token, close enough for keying
-    else:
-        head = ",".join(str(t) for t in list(prefix)[:n])
-    return f"prefix:{head}"
+        # ~chars per token, close enough for keying
+        return "prefix:" + prefix[: n * 4]
+    return prefix_affinity_key(prefix, n)

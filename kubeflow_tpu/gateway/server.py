@@ -139,6 +139,10 @@ class GatewayConfig:
     )
     #: tenant → {max_rps, burst, max_in_flight}
     tenants: dict[str, dict] = dataclasses.field(default_factory=dict)
+    #: service → raw ``autoscaling:`` manifest section (camelCase KPA
+    #: policy + replicaCommand); consumed by ``kft gateway run``, which
+    #: wires a ServingAutoscaler + ReplicaFleet per entry
+    autoscaling: dict[str, dict] = dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_manifest(cls, doc: dict) -> "GatewayConfig":
@@ -191,6 +195,14 @@ class GatewayConfig:
                     cfg.backends.append(
                         (name, be["url"], be.get("revision", "default"))
                     )
+            if "autoscaling" in svc:
+                auto = dict(svc["autoscaling"])
+                if not isinstance(auto.get("replicaCommand", []), list):
+                    raise ValueError(
+                        f"service {name!r}: autoscaling.replicaCommand "
+                        "must be an argv list"
+                    )
+                cfg.autoscaling[name] = auto
         for tenant, pol in (spec.get("policy", {}).get("tenants", {})).items():
             cfg.tenants[tenant] = {
                 "max_rps": pol.get("maxRps"),

@@ -86,6 +86,28 @@ GATEWAY_BREAKER_OPENS_TOTAL = "kft_gateway_breaker_opens_total"
 GATEWAY_BACKENDS_READY = "kft_gateway_backends_ready"
 #: counter{service} — scale-from-zero kicks issued by the activator
 GATEWAY_ACTIVATIONS_TOTAL = "kft_gateway_activations_total"
+#: gauge{service} — activator FIFO depth under its autoscaler-facing name
+#: (an autoscaler input: parked demand counts as concurrency, or
+#: scale-from-zero never happens)
+GATEWAY_ACTIVATOR_QUEUE_DEPTH = "kft_gateway_activator_queue_depth"
+#: gauge{service} — 1 while a cold-episode scale-up kick is outstanding
+GATEWAY_ACTIVATOR_COLD_EPISODE = "kft_gateway_activator_cold_episode"
+
+# -- serving autoscaler (autoscale/) ------------------------------------ #
+
+#: gauge{service} — the recommender's current desired replica count
+AUTOSCALER_DESIRED_REPLICAS = "kft_autoscaler_desired_replicas"
+#: gauge{service} — stable-window average observed concurrency
+AUTOSCALER_STABLE_CONCURRENCY = "kft_autoscaler_stable_concurrency"
+#: gauge{service} — panic-window average observed concurrency
+AUTOSCALER_PANIC_CONCURRENCY = "kft_autoscaler_panic_concurrency"
+#: gauge{service} — 1 while the service is in panic mode (no scale-down)
+AUTOSCALER_PANIC_MODE = "kft_autoscaler_panic_mode"
+#: counter{service,direction} — actuated replica-count changes (up/down)
+AUTOSCALER_SCALE_EVENTS_TOTAL = "kft_autoscaler_scale_events_total"
+#: counter{service} — prefix-KV entries moved between replicas after a
+#: hash-ring remap (scale-up pull / scale-down evacuation)
+AUTOSCALER_KV_TRANSFERS_TOTAL = "kft_autoscaler_kv_transfers_total"
 
 # -- serving ------------------------------------------------------------ #
 
@@ -130,6 +152,11 @@ ENGINE_PREFIX_HITS_TOTAL = "kft_engine_prefix_hits_total"
 ENGINE_PREFIX_TOKENS_REUSED_TOTAL = "kft_engine_prefix_tokens_reused_total"
 ENGINE_PREFIX_ENTRIES = "kft_engine_prefix_entries"
 ENGINE_PREFIX_TOKENS_STORED = "kft_engine_prefix_tokens_stored"
+#: cross-replica prefix-KV transfer (serve/server.py peer endpoints):
+#: entries imported from / exported to a peer replica — a hit served
+#: from an imported entry is KV that was never re-prefilled here
+ENGINE_PREFIX_IMPORTED_TOTAL = "kft_engine_prefix_imported_total"
+ENGINE_PREFIX_EXPORTED_TOTAL = "kft_engine_prefix_exported_total"
 #: speculative decoding (serve/speculative.py): draft tokens proposed /
 #: accepted by the in-graph verify, and the EWMA acceptance ratio — the
 #: tokens-per-forward multiplier prompt-lookup is buying

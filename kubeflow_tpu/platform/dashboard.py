@@ -16,6 +16,10 @@ Read API:
 - ``GET /api/tensorboards`` → board phases + urls
 - ``GET /api/models``       → registered models with stage holders
 - ``GET /api/models/{name}/versions`` → versions + lineage edges
+- ``GET /api/autoscaler``   → serving-autoscaler state (KPA policy,
+  desired vs current, panic mode, folded signals)
+- ``GET /metrics``          → shared prom registry (autoscaler gauges,
+  activator depths, gateway edge counters) in Prometheus text format
 
 CRUD (the web-app analog):
 - ``POST /api/jobs``              body = CRD manifest (any known kind)
@@ -62,6 +66,7 @@ class DashboardServer(ThreadedAiohttpServer):
         volumes=None,       # platform.volumes.VolumeController → /api/volumes
         registry=None,      # registry.store.ModelStore → /api/models
         gateway=None,       # gateway.server.InferenceGateway → /api/gateway
+        autoscaler=None,    # autoscale.ServingAutoscaler → /api/autoscaler
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -76,6 +81,7 @@ class DashboardServer(ThreadedAiohttpServer):
         self.volumes = volumes
         self.registry = registry
         self.gateway = gateway
+        self.autoscaler = autoscaler
 
     # -- views ---------------------------------------------------------- #
 
@@ -220,6 +226,12 @@ class DashboardServer(ThreadedAiohttpServer):
         (probe/breaker/outstanding), activator queue depths, tenant
         policy. Empty when no gateway is attached."""
         return {} if self.gateway is None else self.gateway.state_view()
+
+    def autoscaler_view(self) -> dict:
+        """Serving autoscaler state (autoscale/): per-service KPA policy,
+        live desired vs current, panic mode, last folded signals. Empty
+        when no autoscaler is attached."""
+        return {} if self.autoscaler is None else self.autoscaler.view()
 
     def pipelines_view(self) -> list[dict]:
         return [] if self.lineage is None else self.lineage.runs()
@@ -439,12 +451,22 @@ class DashboardServer(ThreadedAiohttpServer):
                     raise web.HTTPForbidden(reason=f"bad host {host!r}")
             return await handler(request)
 
+        async def metrics(request):
+            from kubeflow_tpu.obs import prom
+
+            # the shared registry: autoscaler recommendation gauges,
+            # activator depths, gateway edge counters — one scrape point
+            # for operators fronting the whole control plane
+            return web.Response(text=prom.REGISTRY.expose())
+
         app = web.Application(middlewares=[csrf_guard])
         app.router.add_get("/", index)
+        app.router.add_get("/metrics", metrics)
         app.router.add_get("/api/summary", handler(self.summary_view))
         app.router.add_get("/api/jobs", handler(self.jobs_view))
         app.router.add_get("/api/queues", handler(self.queues_view))
         app.router.add_get("/api/gateway", handler(self.gateway_view))
+        app.router.add_get("/api/autoscaler", handler(self.autoscaler_view))
         app.router.add_get("/api/profiles", handler(self.profiles_view))
         app.router.add_get("/api/notebooks", handler(self.notebooks_view))
         app.router.add_get("/api/tensorboards", handler(self.tensorboards_view))

@@ -23,6 +23,7 @@ import random
 import time
 from typing import Any
 
+from kubeflow_tpu.autoscale.kpa import KPAConfig, KPARecommender
 from kubeflow_tpu.gateway.router import canary_slot
 from kubeflow_tpu.serve.model import Model, retire as _retire
 from kubeflow_tpu.serve.spec import (
@@ -93,6 +94,9 @@ class InferenceServiceController:
         self.model_dir = model_dir
         self.idle_scale_to_zero_s = idle_scale_to_zero_s
         self._services: dict[str, ServiceState] = {}
+        #: per-service KPA recommenders (autoscale/kpa.py) driving
+        #: autoscale_tick — window state survives across ticks
+        self._recommenders: dict[str, KPARecommender] = {}
         self._rng = rng or random.Random(0)
         #: salts the per-request-id canary hash (same split family the
         #: gateway uses at the edge) — seedable so tests pin the cohort
@@ -167,7 +171,16 @@ class InferenceServiceController:
                 st.conditions.append("PredictorReady")
 
         rs = st.replicas
-        rs.desired_replicas = max(p.min_replicas, min(1, p.max_replicas))
+        # reconcile preserves the CURRENT scale (the autoscaler owns
+        # sizing): the old stub clamped desired to min(1, max) on every
+        # re-apply, collapsing an autoscaled service back to one replica.
+        # A service idled to zero stays at zero across a re-apply — the
+        # next request cold-starts it through the activator path.
+        if rs.ready_replicas == 0 and p.min_replicas == 0 and rs.last_request_ts > 0:
+            want = 0
+        else:
+            want = max(rs.ready_replicas, 1)
+        rs.desired_replicas = max(p.min_replicas, min(want, p.max_replicas))
         if rs.ready_replicas == 0 and rs.desired_replicas > 0:
             rs.ready_replicas = rs.desired_replicas
         st.conditions.append("Ready")
@@ -279,22 +292,45 @@ class InferenceServiceController:
         if old is not None:
             _retire(old)
 
-    def autoscale_tick(self, name: str, namespace: str = "default") -> int:
-        """One autoscaler evaluation; returns the new ready replica count."""
-        st = self.get(name, namespace)
-        p, rs = st.spec.predictor, st.replicas
-        if p.scale_target > 0 and rs.in_flight > 0:
-            want = -(-rs.in_flight // p.scale_target)  # ceil division
+    def _recommender_for(self, key: str, p) -> KPARecommender:
+        """The service's KPA recommender, with its config refreshed from
+        the live predictor spec (operators mutate scale_target / replica
+        bounds between ticks; window state must survive the change)."""
+        cfg = KPAConfig(
+            target=float(max(p.scale_target, 1)),
+            min_replicas=p.min_replicas,
+            max_replicas=max(p.max_replicas, 1),
+            scale_to_zero_grace_s=self.idle_scale_to_zero_s,
+        )
+        rec = self._recommenders.get(key)
+        if rec is None:
+            rec = self._recommenders[key] = KPARecommender(cfg)
         else:
-            want = 1 if rs.in_flight > 0 else rs.ready_replicas
-        idle = time.monotonic() - rs.last_request_ts
-        if (
-            p.min_replicas == 0
-            and rs.in_flight == 0
-            and idle > self.idle_scale_to_zero_s
-        ):
-            want = 0
-        rs.desired_replicas = max(p.min_replicas, min(want, p.max_replicas))
+            rec.config = cfg.validate()
+        return rec
+
+    def autoscale_tick(self, name: str, namespace: str = "default") -> int:
+        """One autoscaler evaluation; returns the new ready replica count.
+
+        The real KPA recommender (autoscale/kpa.py) replaces the old
+        in-flight-snapshot stub: each tick feeds the observed in-flight
+        concurrency into the stable/panic windows and actuates the
+        recommendation. Activity (``route()`` stamping
+        ``last_request_ts``) anchors the scale-to-zero grace window, so
+        a service that just served a request never drops to zero early."""
+        key = f"{namespace}/{name}"
+        st = self._services[key]
+        p, rs = st.spec.predictor, st.replicas
+        rec = self._recommender_for(key, p)
+        rec.observe(rs.in_flight)
+        if rs.last_request_ts > 0:
+            # route() stamps monotonic time; demand anywhere since the
+            # last tick holds the last replica through the grace window
+            rec._last_active_at = max(
+                rec._last_active_at or 0.0, rs.last_request_ts
+            )
+        r = rec.recommend(rs.ready_replicas)
+        rs.desired_replicas = r.desired
         rs.ready_replicas = rs.desired_replicas
         if rs.ready_replicas == 0:  # release HBM when scaled to zero
             for m in (st.default_model, st.canary_model):

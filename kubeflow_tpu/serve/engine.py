@@ -385,6 +385,8 @@ class LMEngine:
         self.stats = {
             "admitted": 0, "completed": 0, "chunks": 0,
             "max_concurrent": 0, "prefix_hits": 0, "prefix_tokens_reused": 0,
+            # cross-replica prefix-KV transfer (peer pull endpoints)
+            "prefix_imported": 0, "prefix_exported": 0,
             "prefill_pieces": 0, "idle_wakes": 0,
             # speculative decoding: drafts proposed/accepted (the tokens-
             # per-forward multiplier — kft_engine_spec_*_total)
@@ -427,6 +429,14 @@ class LMEngine:
         self._prefix_cache: "OrderedDict[tuple, dict] | None" = (
             OrderedDict() if prefix_cache_entries > 0 else None
         )
+        #: guards the prefix-cache maps: the scheduler thread stores and
+        #: looks up on every admission, while the peer-transfer endpoints
+        #: (serve/server.py prefix_cache:pull/:export) index, import and
+        #: export from HTTP executor threads
+        self._prefix_lock = threading.Lock()
+        #: public flag for the peer-transfer endpoints (serve/server.py):
+        #: set once here, never mutated
+        self.prefix_cache_enabled = prefix_cache_entries > 0
         self._prefix_cache_entries = prefix_cache_entries
         self._prefix_cache_tokens = prefix_cache_tokens
         self._prefix_lens: dict[int, int] = {}  # stored length → count
@@ -1338,20 +1348,24 @@ class LMEngine:
         if self._prefix_cache is None:
             return None
         top = (len(ids) - 1) // 16 * 16
-        if self._prefix_lens_sorted is None:
-            # memoized: store/evict invalidate, so the hot admission path
-            # pays the O(L log L) sort only after the length SET changes
-            self._prefix_lens_sorted = sorted(self._prefix_lens, reverse=True)
-        # probe only lengths ACTUALLY stored (descending): a long-prompt
-        # miss costs len(stored-lengths) tuple builds, not len(prompt)/16
-        for n16 in self._prefix_lens_sorted:
-            if n16 > top:
-                continue
-            key = tuple(ids[:n16])
-            entry = self._prefix_cache.get(key)
-            if entry is not None:
-                self._prefix_cache.move_to_end(key)
-                return key, entry
+        with self._prefix_lock:
+            if self._prefix_lens_sorted is None:
+                # memoized: store/evict invalidate, so the hot admission
+                # path pays the O(L log L) sort only after the SET changes
+                self._prefix_lens_sorted = sorted(
+                    self._prefix_lens, reverse=True
+                )
+            # probe only lengths ACTUALLY stored (descending): a long-
+            # prompt miss costs len(stored-lengths) tuple builds, not
+            # len(prompt)/16
+            for n16 in self._prefix_lens_sorted:
+                if n16 > top:
+                    continue
+                key = tuple(ids[:n16])
+                entry = self._prefix_cache.get(key)
+                if entry is not None:
+                    self._prefix_cache.move_to_end(key)
+                    return key, entry
         return None
 
     def _store_prefix(self, ids: list[int], row: int) -> None:
@@ -1365,10 +1379,17 @@ class LMEngine:
         ):
             return
         key = tuple(ids[:n16])
-        if key in self._prefix_cache:
-            self._prefix_cache.move_to_end(key)
-            return
-        self._prefix_cache[key] = self._extract_prefix(row, n16)
+        with self._prefix_lock:
+            if key in self._prefix_cache:
+                self._prefix_cache.move_to_end(key)
+                return
+            self._insert_prefix_locked(key, self._extract_prefix(row, n16))
+
+    def _insert_prefix_locked(self, key: tuple, entry: dict) -> None:
+        """Insert one entry + LRU-evict to bounds. Caller holds
+        ``_prefix_lock``; shared by the store path and the peer import."""
+        n16 = len(key)
+        self._prefix_cache[key] = entry
         if n16 not in self._prefix_lens:
             self._prefix_lens_sorted = None  # length set changed
         self._prefix_lens[n16] = self._prefix_lens.get(n16, 0) + 1
@@ -1886,13 +1907,124 @@ class LMEngine:
     def prefix_cache_stats(self) -> dict:
         """Prefix-cache effectiveness counters for /metrics exposition
         (kft_engine_prefix_*): cumulative hits / tokens reused plus live
-        entry and stored-token occupancy."""
+        entry and stored-token occupancy, and the peer-transfer counters
+        (entries imported from / exported to other replicas)."""
         return {
             "hits": self.stats["prefix_hits"],
             "tokens_reused": self.stats["prefix_tokens_reused"],
             "entries": len(self._prefix_cache or ()),
             "tokens_stored": self._prefix_tokens_stored,
+            "imported": self.stats["prefix_imported"],
+            "exported": self.stats["prefix_exported"],
         }
+
+    # -- cross-replica prefix-KV transfer ----------------------------------- #
+
+    def prefix_index(self) -> list[tuple[int, ...]]:
+        """The stored prefix keys, LRU→MRU — what a peer needs to decide
+        which entries the hash ring now assigns to it."""
+        with self._prefix_lock:
+            return list(self._prefix_cache or ())
+
+    def export_prefix_entries(
+        self, keys=None, *, limit: int | None = None
+    ):
+        """Host copies of stored entries for wire transfer:
+        ``[(key, {layer: {"k": np, "v": np}}), ...]``. ``keys=None``
+        exports everything (MRU last); ``limit`` keeps only the hottest
+        (most recently used) entries. The device→host sync happens
+        OUTSIDE the lock — an export must not stall admissions."""
+        with self._prefix_lock:
+            if self._prefix_cache is None:
+                return []
+            if keys is None:
+                sel = list(self._prefix_cache.items())
+            else:
+                sel = []
+                for k in keys:
+                    k = tuple(int(t) for t in k)
+                    entry = self._prefix_cache.get(k)
+                    if entry is not None:
+                        sel.append((k, entry))
+            if limit is not None and len(sel) > limit:
+                sel = sel[-limit:]  # OrderedDict tail = most recently used
+        out = []
+        for key, stored in sel:
+            out.append((
+                key,
+                {
+                    name: {
+                        "k": np.asarray(lc["k"]),  # kft: noqa[jax-sync] — peer-transfer export runs on an HTTP executor thread, never the scheduler loop
+                        "v": np.asarray(lc["v"]),  # kft: noqa[jax-sync] — same executor-thread D2H; the lock was released before this sync
+                    }
+                    for name, lc in stored.items()
+                },
+            ))
+        self.stats["prefix_exported"] += len(out)
+        return out
+
+    def import_prefix_entries(self, entries) -> int:
+        """Ingest peer-exported entries into this engine's prefix cache.
+        Every entry is validated against THIS engine's layout (layer
+        names, kv_heads, head_dim, 16-token quantum, max_seq fit) —
+        an incompatible entry is skipped, never trusted. Returns the
+        number of entries actually inserted; entries already present do
+        not count (and are not touched — local recency wins)."""
+        if self._prefix_cache is None:
+            return 0
+        H, D = self.cfg.kv_heads, self.cfg.head_dim
+        layer_names = set(self.cache)
+        prepared = []
+        for key, tree in entries:
+            key = tuple(int(t) for t in key)
+            n16 = len(key)
+            if n16 < 16 or n16 % 16 or n16 + 1 > self.max_seq:
+                continue
+            if (
+                self._prefix_cache_tokens is not None
+                and n16 > self._prefix_cache_tokens
+            ):
+                continue
+            if set(tree) != layer_names:
+                continue
+            want = (1, H, n16, D)
+            if any(
+                np.shape(lc.get("k")) != want or np.shape(lc.get("v")) != want
+                for lc in tree.values()
+            ):
+                continue
+            prepared.append((
+                key,
+                {
+                    name: {
+                        "k": jnp.asarray(lc["k"]),
+                        "v": jnp.asarray(lc["v"]),
+                    }
+                    for name, lc in tree.items()
+                },
+            ))
+        imported = 0
+        with self._prefix_lock:
+            for key, tree in prepared:
+                if key in self._prefix_cache:
+                    continue  # resident already: local recency wins
+                self._insert_prefix_locked(key, tree)
+                imported += 1
+        self.stats["prefix_imported"] += imported
+        return imported
+
+    def drop_prefix_cache(self) -> int:
+        """Wipe every stored prefix entry (the chaos ``DropPrefixCache``
+        seam, and warmup's pollution reset). Returns entries dropped."""
+        with self._prefix_lock:
+            if self._prefix_cache is None:
+                return 0
+            n = len(self._prefix_cache)
+            self._prefix_cache.clear()
+            self._prefix_lens.clear()
+            self._prefix_lens_sorted = None
+            self._prefix_tokens_stored = 0
+            return n
 
 
 class _AdmittedStream:
@@ -1977,6 +2109,13 @@ class LMEngineModel(LMRuntimeModel):
         # the engine's own bounded queue) and wait unboundedly
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        #: called after every supervised engine restart — the DataPlane
+        #: registers here to zero its per-model load signals so the
+        #: gateway/autoscaler never size against pre-restart load
+        self._restart_listeners: list = []
+
+    def add_restart_listener(self, fn) -> None:
+        self._restart_listeners.append(fn)
 
     def _make_engine(self) -> LMEngine:
         """One engine instance from the stored knobs — load() builds the
@@ -2008,6 +2147,18 @@ class LMEngineModel(LMRuntimeModel):
         must already be poisoned/stopped — its wedged thread (if any) is
         abandoned and exits on its own."""
         self.engine = self._make_engine().start()
+        # the fresh engine starts with zeroed stats and a cold decode-gap
+        # EWMA; the admission count must match, or load signals report
+        # rows the poison pass already failed. Requests still unwinding
+        # release later — _release clamps at zero so they cannot go
+        # negative against this reset.
+        with self._inflight_lock:
+            self._inflight = 0
+        for fn in list(self._restart_listeners):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a listener must not block
+                pass  # the restart; readiness recovery comes first
         return self.engine
 
     def _set_ready(self, ready: bool) -> None:
@@ -2089,10 +2240,7 @@ class LMEngineModel(LMRuntimeModel):
                     max_new_tokens=min(eng.spec_k + 2, cap),
                 )
         if eng._prefix_cache is not None:
-            eng._prefix_cache.clear()
-            eng._prefix_lens.clear()
-            eng._prefix_lens_sorted = None
-            eng._prefix_tokens_stored = 0
+            eng.drop_prefix_cache()
             n_b = len(self.buckets.seq_lens)
             for j, n16 in enumerate(
                 range(16, self.buckets.seq_lens[-1], 16)
@@ -2133,10 +2281,7 @@ class LMEngineModel(LMRuntimeModel):
                     eng.submit(
                         [tok] * n16 + [tail_tok] * slen, max_new_tokens=2
                     )
-            eng._prefix_cache.clear()
-            eng._prefix_lens.clear()
-            eng._prefix_lens_sorted = None
-            eng._prefix_tokens_stored = 0
+            eng.drop_prefix_cache()
         # warmup traffic must not pollute production metrics (/metrics
         # gauges, hit rates, spec acceptance) — counters restart at zero
         for key in eng.stats:
@@ -2170,7 +2315,9 @@ class LMEngineModel(LMRuntimeModel):
 
     def _release(self, n_rows: int) -> None:
         with self._inflight_lock:
-            self._inflight -= n_rows
+            # clamped: a watchdog restart zeroes the count while poisoned
+            # requests are still unwinding toward their finally-release
+            self._inflight = max(0, self._inflight - n_rows)
 
     def predict(self, rows, headers=None) -> list[dict]:
         # sync path (gRPC, batcher): fan rows out so they share the decode

@@ -152,6 +152,56 @@ def _engine_collector(name: str, model):
     return collect
 
 
+# -- prefix-KV wire format (cross-replica transfer) ----------------------- #
+
+
+def encode_prefix_entries(entries) -> bytes:
+    """``[(key, {layer: {"k": np, "v": np}}), ...]`` → one npz blob. The
+    key list rides inside as JSON bytes so the payload is self-describing
+    (no side-channel headers to drift)."""
+    import io
+    import json
+
+    import numpy as np
+
+    arrays: dict[str, Any] = {}
+    keys = []
+    for i, (key, tree) in enumerate(entries):
+        keys.append([int(t) for t in key])
+        for layer, kv in tree.items():
+            arrays[f"{i}|{layer}|k"] = kv["k"]
+            arrays[f"{i}|{layer}|v"] = kv["v"]
+    arrays["__keys__"] = np.frombuffer(
+        json.dumps(keys).encode(), dtype=np.uint8
+    )
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_prefix_entries(blob: bytes):
+    """Inverse of :func:`encode_prefix_entries`. ``allow_pickle=False``:
+    the payload crosses a network boundary and must stay plain arrays."""
+    import io
+    import json
+
+    import numpy as np
+
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        keys = json.loads(bytes(z["__keys__"]).decode())
+        entries = []
+        for i, key in enumerate(keys):
+            tree: dict[str, dict[str, Any]] = {}
+            prefix = f"{i}|"
+            for name in z.files:
+                if not name.startswith(prefix):
+                    continue
+                _, layer, which = name.split("|", 2)
+                tree.setdefault(layer, {})[which] = z[name]
+            entries.append((tuple(int(t) for t in key), tree))
+    return entries
+
+
 def _batcher_collector(name: str, batcher: Batcher):
     def collect() -> None:
         BATCHER_BATCHES.labels(model=name).set(batcher.stats["batches"])
@@ -191,6 +241,14 @@ class DataPlane:
     def total_inflight(self) -> int:
         return sum(self.inflight.values())
 
+    def reset_load_signals(self, name: str) -> None:
+        """Zero the per-model load signals after a supervised engine
+        restart (called from the watchdog thread via the model's restart
+        listener — a plain dict store, atomic under the GIL). In-flight
+        requests poisoned by the restart unwind through their
+        finally-blocks afterwards; those decrements clamp at zero."""
+        self.inflight[name] = 0
+
     # -- registry -----------------------------------------------------------
 
     def register(self, model: Model, batcher: BatcherConfig | None = None) -> None:
@@ -218,6 +276,14 @@ class DataPlane:
             prom.REGISTRY.add_collector(
                 _engine_collector(model.name, model),
                 key=("engine", model.name),
+            )
+        if hasattr(model, "add_restart_listener"):
+            # a supervised engine restart poisons all pre-restart work:
+            # the load signals the gateway/autoscaler read (inflight,
+            # queue depth) must reset with it, or they size against rows
+            # that no longer exist
+            model.add_restart_listener(
+                lambda name=model.name: self.reset_load_signals(name)
             )
 
     def unregister(self, name: str) -> None:
@@ -312,7 +378,7 @@ class DataPlane:
             else:
                 result = await model(payload, headers)
         finally:
-            self.inflight[name] -= 1
+            self.inflight[name] = max(0, self.inflight.get(name, 0) - 1)
         dt = (time.perf_counter() - t0) * 1e3
         self.metrics["requests_total"][name] = self.metrics["requests_total"].get(name, 0) + 1
         # bounded reservoir: long-lived servers must not accumulate a sample
@@ -401,6 +467,19 @@ class ModelServer:
         app.router.add_post("/v2/models/{name}/generate", self._v2_generate)
         app.router.add_post(
             "/v2/models/{name}/generate_stream", self._v2_generate_stream
+        )
+        # cross-replica prefix-KV transfer (autoscale/kv_transfer.py):
+        # index what this replica holds, export entries to a peer, or
+        # pull the entries a ring remap assigned here from their previous
+        # owner — 501 for non-engine models
+        app.router.add_get(
+            "/v2/models/{name}/prefix_cache", self._prefix_index
+        )
+        app.router.add_post(
+            "/v2/models/{name}/prefix_cache:export", self._prefix_export
+        )
+        app.router.add_post(
+            "/v2/models/{name}/prefix_cache:pull", self._prefix_pull
         )
         # InferenceGraph routing plane ([kserve] cmd/router analog)
         app.router.add_get(
@@ -557,7 +636,7 @@ class ModelServer:
             disconnected.set()  # pump stops; generator close frees the row
             raise
         finally:
-            dp_inflight[name] -= 1
+            dp_inflight[name] = max(0, dp_inflight.get(name, 0) - 1)
             dt = (time.perf_counter() - t0) * 1e3
             m = self.dataplane.metrics
             m["requests_total"][name] = m["requests_total"].get(name, 0) + 1
@@ -569,6 +648,80 @@ class ModelServer:
                      "streamed": True, "complete": not disconnected.is_set()},
                 )
         return resp
+
+    # -- prefix-KV peer transfer ------------------------------------------ #
+
+    def _prefix_engine(self, name: str):
+        model = self.dataplane.get(name)
+        eng = getattr(model, "engine", None)
+        if eng is None or not getattr(eng, "prefix_cache_enabled", False):
+            raise web.HTTPNotImplemented(
+                reason=f"model '{name}' has no prefix cache to transfer"
+            )
+        return eng
+
+    async def _prefix_index(self, req: web.Request) -> web.Response:
+        eng = self._prefix_engine(req.match_info["name"])
+        keys = eng.prefix_index()
+        return web.json_response({
+            "keys": [list(k) for k in keys],
+            "count": len(keys),
+            "tokens": sum(len(k) for k in keys),
+        })
+
+    async def _prefix_export(self, req: web.Request) -> web.Response:
+        eng = self._prefix_engine(req.match_info["name"])
+        try:
+            body = await req.json() if req.can_read_body else {}
+            keys = body.get("keys")
+            limit = body.get("limit")
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        loop = asyncio.get_running_loop()
+        # the device→host sync and npz packing leave the event loop
+        blob = await loop.run_in_executor(
+            None,
+            lambda: encode_prefix_entries(
+                eng.export_prefix_entries(keys, limit=limit)
+            ),
+        )
+        return web.Response(
+            body=blob, content_type="application/octet-stream"
+        )
+
+    async def _prefix_pull(self, req: web.Request) -> web.Response:
+        """Pull stored prefix entries from ``peer`` into this replica's
+        engine — the new-owner side of a hash-ring remap."""
+        name = req.match_info["name"]
+        eng = self._prefix_engine(name)
+        try:
+            body = await req.json()
+            peer = str(body["peer"]).rstrip("/")
+            keys = body.get("keys")
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"{peer}/v2/models/{name}/prefix_cache:export",
+                    json={"keys": keys} if keys is not None else {},
+                    timeout=aiohttp.ClientTimeout(total=120.0),
+                ) as resp:
+                    if resp.status != 200:
+                        raise web.HTTPBadGateway(
+                            reason=f"peer export returned {resp.status}"
+                        )
+                    blob = await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            raise web.HTTPBadGateway(reason=f"peer {peer} unreachable: {e}")
+        loop = asyncio.get_running_loop()
+        imported = await loop.run_in_executor(
+            None,
+            lambda: eng.import_prefix_entries(decode_prefix_entries(blob)),
+        )
+        return web.json_response({"imported": imported, "peer": peer})
 
     async def _v1_status(self, req: web.Request) -> web.Response:
         m = self.dataplane.get(req.match_info["name"])
@@ -752,6 +905,17 @@ class ModelServer:
             lines.append(
                 f'{names.ENGINE_PREFIX_TOKENS_STORED}{{model="{name}"}} '
                 f'{pc["tokens_stored"]}'
+            )
+            # cross-replica transfer counters: a hit on an imported entry
+            # is KV this replica never re-prefilled (the burst e2e's
+            # recovery assertion reads these per-replica)
+            lines.append(
+                f'{names.ENGINE_PREFIX_IMPORTED_TOTAL}{{model="{name}"}} '
+                f'{pc["imported"]}'
+            )
+            lines.append(
+                f'{names.ENGINE_PREFIX_EXPORTED_TOTAL}{{model="{name}"}} '
+                f'{pc["exported"]}'
             )
             pager = getattr(eng, "pager", None)
             if pager is not None:  # paged-KV engines: live pool pressure

@@ -565,4 +565,140 @@ async def main():
 asyncio.run(main())
 EOF
 
+echo "== autoscaler burst: 1->3->1->0 scale cycle, zero failures, prefix-KV transfer =="
+python - <<'EOF'
+import asyncio, json, time, urllib.request
+
+import jax, jax.numpy as jnp
+
+from kubeflow_tpu.autoscale import (
+    GatewaySignalSource, KPAConfig, ReplicaFleet, ServingAutoscaler,
+)
+from kubeflow_tpu.gateway.router import ServiceRoute
+from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from kubeflow_tpu.obs.prom import REGISTRY
+from kubeflow_tpu.serve.engine import LMEngineModel
+from kubeflow_tpu.serve.model import BucketSpec
+from kubeflow_tpu.serve.server import ModelServer
+
+cfg = TransformerConfig(vocab_size=89, d_model=32, n_layers=2, n_heads=4,
+                        d_ff=64, causal=True, max_seq_len=256,
+                        attn_impl="reference", dtype=jnp.float32)
+tlm = TransformerLM(cfg)
+params = tlm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def metric(name, **labels):
+    m = REGISTRY._metrics.get(name)
+    child = m._children.get(tuple(sorted(labels.items()))) if m else None
+    return child.value if child else 0.0
+
+
+async def main():
+    servers = {}
+
+    async def launch(index):
+        m = LMEngineModel(
+            "m", None, config=cfg, max_batch=4, chunk_steps=2,
+            buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+            max_new_tokens=24, eos_id=cfg.vocab_size + 1, watchdog=False,
+            prefix_cache_entries=32,
+        )
+        m.load()
+        m._params = jax.device_put(params)  # identical weights per replica
+        m.engine.stop()
+        m.engine = m._make_engine().start()
+        ms = ModelServer([m], http_port=0)
+        await ms.start_async()
+        (site,) = ms._runner.sites
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+
+        async def stop():
+            m.unload()
+            await ms.stop_async()
+
+        servers[url] = (m, ms)
+        return url, stop
+
+    asc = ServingAutoscaler(tick_interval_s=0.15)
+    gw = InferenceGateway(GatewayConfig(
+        probe_interval_s=0.25, activation_timeout_s=60.0,
+        routes=[ServiceRoute(name="m")],
+    ), scale_up=asc.kick)
+    fleet = ReplicaFleet("m", launch, pool=gw.pool, model="m")
+    source = GatewaySignalSource(gw, "m")
+    asc.add_service("m", KPAConfig(
+        target=1.0, min_replicas=0, max_replicas=3,
+        stable_window_s=3.0, panic_window_s=0.6, panic_threshold=1.5,
+        max_scale_down_rate=2.0, scale_to_zero_grace_s=1.2,
+    ), source, fleet)
+    await fleet.scale_to(1)
+    await gw.start_async()
+    loop = asyncio.get_running_loop()
+    prompts = [[2 + (7 * i + j) % 80 for j in range(17)] for i in range(10)]
+
+    def predict(i):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.http_port}/v1/models/m:predict",
+            data=json.dumps(
+                {"instances": [{"input_ids": prompts[i % len(prompts)]}]}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-request-id": f"burst-{i}"},
+        )
+        with urllib.request.urlopen(req, timeout=180) as r:
+            return r.status
+
+    try:
+        for i in range(3):  # warm replica 0 through its compiles
+            assert await loop.run_in_executor(None, predict, i) == 200
+        asc.start()
+        peak = [fleet.current()]
+
+        async def watch():
+            while True:
+                peak[0] = max(peak[0], fleet.current())
+                await asyncio.sleep(0.03)
+
+        watcher = asyncio.ensure_future(watch())
+        # open-loop burst: fixed arrivals, nobody waits on responses
+        tasks = []
+        for i in range(40):
+            tasks.append(loop.run_in_executor(None, predict, 100 + i))
+            await asyncio.sleep(0.025)
+        statuses = await asyncio.gather(*tasks)
+        assert statuses == [200] * 40, statuses
+        deadline = time.monotonic() + 90
+        while peak[0] < 3 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert peak[0] == 3, f"never panicked to 3 (peak {peak[0]})"
+        moved = fleet.stats["kv_entries_moved"]
+        assert moved >= 1, "scale-up replicas pulled no prefix KV"
+        # quiet: stable window drains, grace expires, replicas -> 0
+        deadline = time.monotonic() + 90
+        while fleet.current() > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert fleet.current() == 0, fleet.current()
+        watcher.cancel()
+        # scale-from-zero: parked in the activator, kick relaunches
+        acts0 = metric("kft_gateway_activations_total", service="m")
+        assert await loop.run_in_executor(None, predict, 999) == 200
+        assert fleet.current() == 1
+        assert metric("kft_gateway_activations_total", service="m") == acts0 + 1
+        print(f"autoscaler OK: 40-request burst 1->3 (panic), idle ->0, "
+              f"cold request served via activator; prefix-KV entries "
+              f"moved={moved}, "
+              f"scale_events_up="
+              f"{metric('kft_autoscaler_scale_events_total', service='m', direction='up'):.0f}")
+    finally:
+        await asc.stop()
+        await source.close()
+        await fleet.close()
+        await gw.stop_async()
+
+asyncio.run(main())
+EOF
+
 echo "smoke OK"

@@ -1,0 +1,908 @@
+"""Serving autoscaler (KPA analog) + cross-replica prefix-KV transfer.
+
+Unit layers: the recommender's stable/panic/scale-to-zero state machine,
+prom-text signal folding, hash-ring remap planning (previous-owner pull
+targeting), engine prefix export/import, the controller's recommender-
+backed ``autoscale_tick``, load-signal reset after a watchdog restart,
+and the activator's autoscaler-facing gauges.
+
+Chaos acceptance e2es (the ISSUE 11 criteria): an open-loop burst that
+scales real replicas 1→3 (panic) and back down to zero with no
+client-visible failure, scale-from-zero through the activator; and a
+ring remap whose new replica recovers its prefix-hit rate by pulling KV
+from the previous owner instead of re-prefilling."""
+
+import asyncio
+import time
+
+import pytest
+
+from kubeflow_tpu.autoscale.kpa import KPAConfig, KPARecommender, _Window
+from kubeflow_tpu.autoscale.autoscaler import ServingAutoscaler
+from kubeflow_tpu.autoscale.fleet import ReplicaFleet
+from kubeflow_tpu.autoscale.kv_transfer import owner_of, plan_rebalance
+from kubeflow_tpu.autoscale.signals import (
+    GatewaySignalSource,
+    ServiceSignals,
+    fold_replica_metrics,
+    metric_sum,
+    parse_prom_text,
+)
+from kubeflow_tpu.gateway.router import HashRing, prefix_affinity_key
+from kubeflow_tpu.obs.prom import REGISTRY
+from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.serve.server import (
+    DataPlane,
+    ModelServer,
+    decode_prefix_entries,
+    encode_prefix_entries,
+)
+
+
+def _metric(name, **labels):
+    m = REGISTRY._metrics.get(name)
+    if m is None:
+        return 0.0
+    child = m._children.get(tuple(sorted(labels.items())))
+    return child.value if child else 0.0
+
+
+# ------------------------------------------------------------------- KPA
+
+
+def test_window_average_prunes_and_windows():
+    w = _Window(10.0)
+    for t, v in [(0.0, 4.0), (5.0, 2.0), (9.0, 6.0)]:
+        w.observe(t, v)
+    assert w.average(9.0, 10.0) == pytest.approx(4.0)
+    assert w.average(9.0, 1.0) == pytest.approx(6.0)  # short window
+    assert w.average(9.0, 0.5) == pytest.approx(6.0)
+    w.observe(20.0, 8.0)  # t=0,5,9 now pruned (older than 10s)
+    assert w.average(20.0, 10.0) == pytest.approx(8.0)
+    assert w.average(30.0, 5.0) == 0.0  # empty window → no demand
+
+
+def test_kpa_stable_scaling_and_rate_limits():
+    cfg = KPAConfig(
+        target=2.0, min_replicas=1, max_replicas=10,
+        stable_window_s=10.0, panic_window_s=2.0,
+        panic_threshold=10.0,  # effectively off for this test
+        max_scale_down_rate=2.0,
+    )
+    rec = KPARecommender(cfg, clock=lambda: 0.0)
+    rec.observe(8.0, now=1.0)
+    assert rec.recommend(2, now=1.0).desired == 4  # ceil(8/2)
+    # scale-down is rate-limited: from 8 ready it may halve at most
+    rec2 = KPARecommender(cfg, clock=lambda: 0.0)
+    rec2.observe(2.0, now=1.0)
+    assert rec2.recommend(8, now=1.0).desired == 4  # floor(8/2), not 1
+    # bounds clamp
+    rec3 = KPARecommender(cfg, clock=lambda: 0.0)
+    rec3.observe(100.0, now=1.0)
+    assert rec3.recommend(4, now=1.0).desired == 10
+
+
+def test_kpa_panic_mode_enters_scales_and_refuses_scale_down():
+    cfg = KPAConfig(
+        target=1.0, min_replicas=1, max_replicas=10,
+        stable_window_s=20.0, panic_window_s=2.0, panic_threshold=2.0,
+    )
+    rec = KPARecommender(cfg, clock=lambda: 0.0)
+    rec.observe(6.0, now=1.0)  # burst: 6 concurrent at 1 replica
+    r = rec.recommend(1, now=1.0)
+    assert r.panic and r.desired == 6
+    # burst ends; panic persists a full stable window → no scale-down
+    rec.observe(0.0, now=5.0)
+    r = rec.recommend(6, now=5.0)
+    assert r.panic and r.desired == 6
+    # a stable window after the last panic signal, panic exits and the
+    # (now decayed) stable average sizes the service back down
+    rec.observe(0.0, now=22.0)
+    r = rec.recommend(6, now=22.0)
+    assert not r.panic
+    assert r.desired == 3  # rate-limited: floor(6/2), not straight to 1
+
+
+def test_kpa_scale_to_zero_grace_and_activation():
+    cfg = KPAConfig(
+        target=1.0, min_replicas=0, max_replicas=4,
+        stable_window_s=10.0, panic_window_s=2.0,
+        scale_to_zero_grace_s=5.0,
+    )
+    rec = KPARecommender(cfg, clock=lambda: 0.0)
+    rec.observe(1.0, now=1.0)
+    assert rec.recommend(1, now=1.0).desired == 1
+    # idle but inside the grace window: the last replica is held
+    rec.observe(0.0, now=4.0)
+    assert rec.recommend(1, now=4.0).desired == 1
+    # grace expired → zero
+    rec.observe(0.0, now=12.0)
+    assert rec.recommend(1, now=12.0).desired == 0
+    # at zero with no demand it stays at zero
+    rec.observe(0.0, now=13.0)
+    assert rec.recommend(0, now=13.0).desired == 0
+    # the activator's kick (parked demand) wakes it
+    rec.activity(now=14.0)
+    rec.observe(1.0, now=14.0)
+    assert rec.recommend(0, now=14.0).desired == 1
+
+
+def test_kpa_config_validation_and_manifest():
+    with pytest.raises(ValueError):
+        KPAConfig(target=0).validate()
+    with pytest.raises(ValueError):
+        KPAConfig(min_replicas=3, max_replicas=2).validate()
+    with pytest.raises(ValueError):
+        KPAConfig(panic_window_s=70.0, stable_window_s=60.0).validate()
+    with pytest.raises(ValueError):
+        KPAConfig(panic_threshold=0.5).validate()
+    cfg = KPAConfig.from_manifest({
+        "target": 4, "minReplicas": 0, "maxReplicas": 6,
+        "stableWindowS": 30, "panicWindowS": 3, "panicThreshold": 1.5,
+        "scaleToZeroGraceS": 10,
+    })
+    assert cfg.target == 4.0 and cfg.min_replicas == 0
+    assert cfg.max_replicas == 6 and cfg.panic_threshold == 1.5
+
+
+# -------------------------------------------------------------- signals
+
+
+def test_parse_prom_text_and_fold():
+    text = "\n".join([
+        "# HELP kft_server_inflight requests executing",
+        'kft_server_inflight{model="m"} 3',
+        'kft_server_inflight{model="n"} 2',
+        'kft_server_queue_depth{model="m"} 4',
+        'kft_engine_decode_gap_ms{model="m"} 12.5',
+        "not a metric line {{{",
+        "kft_bare_counter 7",
+    ])
+    parsed = parse_prom_text(text)
+    assert metric_sum(parsed, "kft_server_inflight") == 5.0
+    assert metric_sum(parsed, "kft_server_inflight", model="m") == 3.0
+    assert metric_sum(parsed, "kft_bare_counter") == 7.0
+    sig = ServiceSignals(activator_depth=2.0)
+    fold_replica_metrics(sig, parsed)
+    assert sig.inflight == 5.0 and sig.queue_depth == 4.0
+    assert sig.decode_gap_ms == 12.5 and sig.replicas_reporting == 1
+    assert sig.concurrency == 11.0  # inflight + queue + parked
+
+
+# ------------------------------------------------- ring remap + planning
+
+
+def _keys(n, seed=0):
+    import random
+
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        out.append(tuple(rng.randrange(2, 60) for _ in range(16)))
+    return out
+
+
+def test_hash_ring_remap_keeps_unmoved_keys_stable():
+    """Consistent-hashing acceptance: adding a replica only moves keys TO
+    it; removing one only moves the keys it owned."""
+    a, b, c = "http://a", "http://b", "http://c"
+    keys = _keys(200)
+    two = HashRing((a, b))
+    three = HashRing((a, b, c))
+    moved = 0
+    for k in keys:
+        o2 = two.pick(prefix_affinity_key(k))
+        o3 = three.pick(prefix_affinity_key(k))
+        if o2 != o3:
+            assert o3 == c, (o2, o3)  # movement only toward the newcomer
+            moved += 1
+    assert 0 < moved < len(keys)  # some moved, most did not
+    for k in keys:  # removal: survivors keep everything they had
+        o3 = three.pick(prefix_affinity_key(k))
+        o2 = two.pick(prefix_affinity_key(k))
+        if o3 != c:
+            assert o2 == o3
+
+
+def test_plan_rebalance_scale_up_pulls_from_previous_owner_only():
+    a, b, c = "http://a", "http://b", "http://c"
+    keys = _keys(120, seed=1)
+    two = HashRing((a, b))
+    # steady state before the remap: every key lives on its 2-ring owner
+    index = {a: [], b: []}
+    for k in keys:
+        index[two.pick(prefix_affinity_key(k))].append(k)
+    plan = plan_rebalance(index, [a, b, c])
+    assert plan, "remap moved nothing — ring fixture broken"
+    three = HashRing((a, b, c))
+    planned = set()
+    for t in plan:
+        assert t.dest == c  # scale-up: only the newcomer gains keys
+        for k in t.keys:
+            # the pull source IS the previous owner (where the KV lives)
+            assert t.source == two.pick(prefix_affinity_key(k))
+            assert three.pick(prefix_affinity_key(k)) == c
+            planned.add(k)
+    # completeness: every key the new ring assigns to c is planned
+    want = {k for k in keys if three.pick(prefix_affinity_key(k)) == c}
+    assert planned == want
+    # unmoved keys never transfer
+    assert not any(
+        three.pick(prefix_affinity_key(k)) != c
+        for t in plan for k in t.keys
+    )
+
+
+def test_plan_rebalance_dedups_and_handles_scale_down():
+    a, b, c = "http://a", "http://b", "http://c"
+    keys = _keys(60, seed=2)
+    three = HashRing((a, b, c))
+    index = {a: [], b: [], c: []}
+    for k in keys:
+        index[three.pick(prefix_affinity_key(k))].append(k)
+    # a key resident on BOTH survivors that the owner already holds must
+    # not transfer at all
+    dup = index[a][0] if index[a] else index[b][0]
+    index[b].append(dup)
+    # scale-down: c leaves; its entries evacuate to the 2-ring owners
+    plan = plan_rebalance(index, [a, b])
+    two = HashRing((a, b))
+    for t in plan:
+        assert t.source == c  # only the leaver's keys move
+        for k in t.keys:
+            assert t.dest == two.pick(prefix_affinity_key(k))
+            assert k != dup
+    evacuated = {k for t in plan for k in t.keys}
+    assert evacuated == set(map(tuple, index[c]))
+    # each key transfers exactly once
+    assert len(evacuated) == sum(len(t.keys) for t in plan)
+
+
+def test_owner_of_matches_gateway_affinity_hash():
+    urls = ("http://a", "http://b")
+    ring = HashRing(urls)
+    key = tuple(range(2, 18))
+    assert owner_of(key, ring) == ring.pick(prefix_affinity_key(key))
+
+
+# ------------------------------------------- engine export/import + wire
+
+
+def _tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+        causal=True, max_seq_len=128, attn_impl="reference",
+        dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+def _tiny_engine(cfg, model, params, **kw):
+    from kubeflow_tpu.serve.engine import LMEngine
+
+    kw.setdefault("prefix_cache_entries", 8)
+    return LMEngine(
+        model, cfg, params, max_batch=2, max_seq=96, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=cfg.vocab_size + 1, **kw
+    ).start()
+
+
+def test_engine_prefix_export_import_roundtrip_serves_hits():
+    cfg, model, params = _tiny_lm()
+    a = _tiny_engine(cfg, model, params)
+    b = _tiny_engine(cfg, model, params)
+    try:
+        prompt = [5, 9, 13, 7] * 5  # 20 tokens → one 16-token entry
+        out_a = a.submit(prompt, max_new_tokens=8)
+        assert a.prefix_index() == [tuple(prompt[:16])]
+        blob = encode_prefix_entries(a.export_prefix_entries())
+        assert a.stats["prefix_exported"] == 1
+        entries = decode_prefix_entries(blob)
+        assert b.import_prefix_entries(entries) == 1
+        assert b.stats["prefix_imported"] == 1
+        # a re-import of a resident key is a no-op (local recency wins)
+        assert b.import_prefix_entries(entries) == 0
+        # the imported KV actually serves: same tokens, prefix hit, no
+        # full re-prefill (16 of 20 prompt tokens reused)
+        out_b = b.submit(prompt, max_new_tokens=8)
+        assert out_b == out_a
+        assert b.stats["prefix_hits"] == 1
+        assert b.stats["prefix_tokens_reused"] == 16
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_engine_import_rejects_incompatible_entries():
+    import numpy as np
+
+    cfg, model, params = _tiny_lm()
+    eng = _tiny_engine(cfg, model, params)
+    try:
+        layer = next(iter(eng.cache))
+        good_shape = (1, cfg.kv_heads, 16, cfg.head_dim)
+        bad = [
+            # wrong head count
+            (tuple(range(2, 18)), {
+                name: {
+                    "k": np.zeros((1, cfg.kv_heads + 1, 16, cfg.head_dim)),
+                    "v": np.zeros((1, cfg.kv_heads + 1, 16, cfg.head_dim)),
+                }
+                for name in eng.cache
+            }),
+            # not a 16 multiple
+            (tuple(range(2, 17)), {
+                name: {"k": np.zeros(good_shape), "v": np.zeros(good_shape)}
+                for name in eng.cache
+            }),
+            # missing layers
+            (tuple(range(2, 18)), {
+                layer: {"k": np.zeros(good_shape), "v": np.zeros(good_shape)}
+            }),
+        ]
+        assert eng.import_prefix_entries(bad) == 0
+        assert eng.prefix_cache_stats()["entries"] == 0
+    finally:
+        eng.stop()
+
+
+def test_drop_prefix_cache_injector_and_fault_kind():
+    from kubeflow_tpu.chaos import DropPrefixCache, FaultPlan
+    from kubeflow_tpu.chaos.injectors import drop_prefix_cache
+
+    plan = FaultPlan.from_dict({
+        "faults": [{"kind": "DropPrefixCache", "model": "m"}]
+    })
+    assert isinstance(plan.faults[0], DropPrefixCache)
+    assert plan.faults[0].model == "m"
+
+    cfg, model, params = _tiny_lm()
+    eng = _tiny_engine(cfg, model, params)
+    try:
+        eng.submit([5, 9, 13, 7] * 5, max_new_tokens=4)
+        assert eng.prefix_cache_stats()["entries"] == 1
+        before = _metric("kft_chaos_injected_total", kind="drop_prefix_cache")
+        assert drop_prefix_cache(eng) == 1
+        assert eng.prefix_cache_stats()["entries"] == 0
+        assert eng.prefix_cache_stats()["tokens_stored"] == 0
+        assert _metric(
+            "kft_chaos_injected_total", kind="drop_prefix_cache"
+        ) == before + 1
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------- controller (satellite)
+
+
+def test_controller_autoscale_tick_recommender_and_reapply_preserves_scale(
+    tmp_path,
+):
+    from kubeflow_tpu.serve.controller import InferenceServiceController
+    from kubeflow_tpu.serve.model import EchoModel
+    from kubeflow_tpu.serve.spec import (
+        InferenceServiceSpec,
+        PredictorSpec,
+        RuntimeRegistry,
+        ServingRuntime,
+    )
+
+    reg = RuntimeRegistry()
+    reg.register(ServingRuntime(
+        "echo", ("echo",), lambda name, path, **kw: EchoModel(name)
+    ))
+    ctl = InferenceServiceController(
+        reg, model_dir=str(tmp_path), idle_scale_to_zero_s=60.0
+    )
+    spec = InferenceServiceSpec("s", PredictorSpec(
+        model_format="echo", min_replicas=1, max_replicas=4, scale_target=2,
+    ))
+    ctl.apply(spec)
+    st = ctl.get("s")
+    st.replicas.in_flight = 8
+    assert ctl.autoscale_tick("s") == 4  # ceil(8/2), real recommender
+    # the old reconcile stub clamped desired to min(1, max) on re-apply,
+    # collapsing an autoscaled service — now it preserves current scale
+    ctl.apply(InferenceServiceSpec("s", PredictorSpec(
+        model_format="echo", min_replicas=1, max_replicas=4, scale_target=2,
+    )))
+    assert ctl.get("s").replicas.desired_replicas == 4
+    # burst over: panic mode holds the scale for a stable window instead
+    # of collapsing to 1 the instant in-flight drops
+    st = ctl.get("s")
+    st.replicas.in_flight = 0
+    assert ctl.autoscale_tick("s") == 4
+
+
+# -------------------------------------- load-signal reset (satellite)
+
+
+def test_engine_restart_resets_load_signals():
+    import jax
+
+    from kubeflow_tpu.serve.engine import LMEngineModel
+    from kubeflow_tpu.serve.model import BucketSpec
+
+    cfg, _, params = _tiny_lm()
+    m = LMEngineModel(
+        "m", None, config=cfg, max_batch=2, chunk_steps=2,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+        max_new_tokens=4, eos_id=cfg.vocab_size + 1, watchdog=False,
+    )
+    m.load()
+    m._params = jax.device_put(params)
+    dp = DataPlane()
+    dp.register(m)
+    try:
+        # fake pre-restart load: the autoscaler/gateway would read these
+        dp.inflight["m"] = 5
+        m._inflight = 3
+        m.engine.overlap["decode_gap_ms"] = 42.0
+        old = m.engine
+        old.poison(RuntimeError("test trip"))  # the watchdog's order
+        m.restart_engine()
+        old.stop()  # joins the (unwedged) old scheduler thread
+        assert dp.inflight["m"] == 0
+        assert m._inflight == 0
+        # fresh engine: decode-gap EWMA restarts cold
+        assert m.engine.overlap["decode_gap_ms"] == 0.0
+        # a poisoned request unwinding its finally-release cannot push
+        # the admission count negative after the reset
+        m._release(2)
+        assert m._inflight == 0
+        dp.reset_load_signals("m")
+        assert dp.inflight["m"] == 0
+    finally:
+        dp.unregister("m")
+
+
+# ---------------------------------------- activator gauges (satellite)
+
+
+def test_activator_exports_autoscaler_gauges():
+    from kubeflow_tpu.gateway.activator import Activator
+
+    async def run():
+        kicked = []
+        act = Activator(timeout_s=5.0, scale_up=kicked.append)
+
+        async def parked():
+            await act.wait("svc-g")
+
+        t = asyncio.ensure_future(parked())
+        await asyncio.sleep(0.01)
+        assert _metric(
+            "kft_gateway_activator_queue_depth", service="svc-g"
+        ) == 1
+        assert _metric(
+            "kft_gateway_activator_cold_episode", service="svc-g"
+        ) == 1
+        assert kicked == ["svc-g"]
+        act.notify("svc-g")
+        await t
+        assert _metric(
+            "kft_gateway_activator_queue_depth", service="svc-g"
+        ) == 0
+        assert _metric(
+            "kft_gateway_activator_cold_episode", service="svc-g"
+        ) == 0
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------- autoscaler control loop
+
+
+def test_autoscaler_ticks_actuate_and_export_metrics():
+    async def run():
+        t = [0.0]
+
+        class Actuator:
+            def __init__(self):
+                self.n = 1
+                self.calls = []
+
+            def current(self):
+                return self.n
+
+            async def scale_to(self, n):
+                self.calls.append(n)
+                self.n = n
+
+        box = {"sig": ServiceSignals(inflight=6.0)}
+
+        async def signals():
+            return box["sig"]
+
+        act = Actuator()
+        asc = ServingAutoscaler(clock=lambda: t[0])
+        asc.add_service(
+            "svc-a",
+            KPAConfig(
+                target=1.0, min_replicas=0, max_replicas=8,
+                stable_window_s=10.0, panic_window_s=2.0,
+                scale_to_zero_grace_s=4.0,
+            ),
+            signals,
+            act,
+        )
+        ups = _metric(
+            "kft_autoscaler_scale_events_total",
+            service="svc-a", direction="up",
+        )
+        t[0] = 1.0
+        r = await asc.tick_service("svc-a")
+        assert r.desired == 6 and act.calls == [6] and r.panic
+        assert _metric(
+            "kft_autoscaler_desired_replicas", service="svc-a"
+        ) == 6
+        assert _metric("kft_autoscaler_panic_mode", service="svc-a") == 1
+        assert _metric(
+            "kft_autoscaler_scale_events_total",
+            service="svc-a", direction="up",
+        ) == ups + 1
+        # idle long past the stable window: panic exits, windows drain,
+        # grace expires → rate-limited march down to zero
+        box["sig"] = ServiceSignals()
+        for step in range(8):
+            t[0] = 20.0 + 5.0 * step
+            await asc.tick_service("svc-a")
+        assert act.n == 0
+        assert asc.view()["svc-a"]["current"] == 0
+        # the activator kick path: parked demand scales from zero NOW
+        box["sig"] = ServiceSignals(activator_depth=2.0)
+        t[0] = 70.0
+        asc.kick("svc-a")
+        await asyncio.sleep(0.05)  # kick's out-of-band tick task
+        assert act.n >= 1
+
+    asyncio.run(run())
+
+
+# -------------------------------------------- manifest + dashboard wiring
+
+
+def test_gateway_manifest_autoscaling_section_and_validation():
+    from kubeflow_tpu.gateway.server import GatewayConfig
+
+    cfg = GatewayConfig.from_manifest({
+        "kind": "InferenceGateway",
+        "metadata": {"name": "edge"},
+        "spec": {
+            "services": [{
+                "name": "m",
+                "autoscaling": {
+                    "minReplicas": 0, "maxReplicas": 3, "target": 2,
+                    "panicThreshold": 1.5,
+                    "replicaCommand": ["python", "-m", "kubeflow_tpu",
+                                       "serve", "-f", "isvc.yaml",
+                                       "--http-port", "0",
+                                       "--port-file", "{port_file}"],
+                },
+            }],
+        },
+    })
+    auto = cfg.autoscaling["m"]
+    kpa = KPAConfig.from_manifest(auto)
+    assert kpa.min_replicas == 0 and kpa.max_replicas == 3
+    assert kpa.target == 2.0 and kpa.panic_threshold == 1.5
+    assert auto["replicaCommand"][0] == "python"
+    with pytest.raises(ValueError, match="replicaCommand"):
+        GatewayConfig.from_manifest({
+            "kind": "InferenceGateway",
+            "spec": {"services": [{
+                "name": "m",
+                "autoscaling": {"replicaCommand": "not-an-argv-list"},
+            }]},
+        })
+
+
+def test_dashboard_autoscaler_api_and_metrics():
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubeflow_tpu.platform.dashboard import DashboardServer
+
+        class Act:
+            def current(self):
+                return 2
+
+        asc = ServingAutoscaler()
+
+        async def signals():
+            return ServiceSignals(inflight=1.0)
+
+        asc.add_service("m", KPAConfig(max_replicas=4), signals, Act())
+        await asc.tick_service("m")  # populate the recommendation gauges
+        dash = DashboardServer(cluster=None, autoscaler=asc)
+        async with TestClient(TestServer(dash._make_app())) as client:
+            body = await (await client.get("/api/autoscaler")).json()
+            assert body["m"]["current"] == 2
+            assert body["m"]["config"]["max_replicas"] == 4
+            assert body["m"]["desired"] is not None
+            # the shared registry rides the dashboard's /metrics too —
+            # the satellite's "surfaced on gateway and dashboard" half
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+            assert 'kft_autoscaler_desired_replicas{service="m"}' in text
+        assert DashboardServer(cluster=None).autoscaler_view() == {}
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------ e2e helpers
+
+
+class _SlowModel(Model):
+    """Echo with latency: concurrency accumulates so the scraped
+    kft_server_inflight signal actually moves during a burst."""
+
+    def __init__(self, name: str, delay_s: float):
+        super().__init__(name)
+        self.delay_s = delay_s
+        self.ready = True
+
+    async def __call__(self, payload, headers=None):
+        await asyncio.sleep(self.delay_s)
+        n = len(payload.get("instances", [0]))
+        return {"predictions": ["ok"] * n}
+
+
+async def _test_server(ms: ModelServer):
+    from aiohttp.test_utils import TestServer
+
+    srv = TestServer(ms.build_app())
+    await srv.start_server()
+    return srv, f"http://127.0.0.1:{srv.port}"
+
+
+# --------------------------------------------------- chaos acceptance e2e
+
+
+@pytest.mark.chaos
+def test_burst_scales_1_3_1_then_zero_with_no_client_failures():
+    """ISSUE 11 acceptance, part 1: an open-loop burst against the REAL
+    gateway + real ModelServer replicas panics the autoscaler 1→3, the
+    quiet stable window brings it back down through 1 to zero, and the
+    first request after scale-to-zero is served via activator buffering —
+    every client request 200 throughout."""
+    from aiohttp.test_utils import TestClient, TestServer as _TS
+
+    from kubeflow_tpu.gateway.router import ServiceRoute
+    from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+
+    async def run():
+        replicas = []
+
+        async def launch(index):
+            ms = ModelServer([_SlowModel("m", delay_s=0.3)], http_port=0)
+            srv, url = await _test_server(ms)
+            replicas.append(srv)
+
+            async def stop():
+                await srv.close()
+
+            return url, stop
+
+        gw_box = {}
+        asc = ServingAutoscaler(tick_interval_s=0.1)
+        gw = InferenceGateway(
+            GatewayConfig(
+                probe_interval_s=0.25,
+                activation_timeout_s=20.0,
+                routes=[ServiceRoute(name="m")],
+            ),
+            scale_up=asc.kick,
+        )
+        gw_box["gw"] = gw
+        fleet = ReplicaFleet("m", launch, pool=gw.pool)
+        source = GatewaySignalSource(gw, "m")
+        asc.add_service(
+            "m",
+            KPAConfig(
+                target=2.0, min_replicas=0, max_replicas=3,
+                stable_window_s=2.5, panic_window_s=0.5,
+                panic_threshold=1.5, max_scale_down_rate=2.0,
+                scale_to_zero_grace_s=1.0,
+            ),
+            source,
+            fleet,
+        )
+        await fleet.scale_to(1)
+        client = TestClient(_TS(gw.build_app()))
+        await client.start_server()
+        asc.start()
+        statuses = []
+        peak = [0]
+
+        async def one(i):
+            r = await client.post(
+                "/v1/models/m:predict",
+                json={"instances": [[i]]},
+                headers={"x-request-id": f"burst-{i}"},
+            )
+            statuses.append(r.status)
+            await r.release()
+
+        async def watch_peak():
+            while True:
+                peak[0] = max(peak[0], fleet.current())
+                await asyncio.sleep(0.02)
+
+        watcher = asyncio.ensure_future(watch_peak())
+        try:
+            # open-loop burst: fixed arrival rate, no waiting on responses
+            tasks = []
+            for i in range(40):
+                tasks.append(asyncio.ensure_future(one(i)))
+                await asyncio.sleep(0.04)
+            await asyncio.gather(*tasks)
+            assert statuses == [200] * 40, statuses
+
+            deadline = time.monotonic() + 20.0
+            while peak[0] < 3 and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert peak[0] == 3, f"never scaled to 3 (peak {peak[0]})"
+
+            # quiet: panic exits after the stable window, then the grace
+            # window expires and the service reaches zero
+            deadline = time.monotonic() + 30.0
+            while fleet.current() > 0 and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert fleet.current() == 0, fleet.current()
+            assert _metric(
+                "kft_autoscaler_desired_replicas", service="m"
+            ) == 0
+
+            # scale-from-zero: the request parks in the activator, the
+            # cold-episode kick relaunches a replica, the flush serves it
+            acts0 = _metric("kft_gateway_activations_total", service="m")
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[99]]},
+                headers={"x-request-id": "cold-99"},
+            )
+            assert r.status == 200, await r.text()
+            await r.release()
+            assert fleet.current() == 1
+            assert _metric(
+                "kft_gateway_activations_total", service="m"
+            ) == acts0 + 1
+            assert _metric(
+                "kft_autoscaler_scale_events_total",
+                service="m", direction="up",
+            ) >= 2
+            assert _metric(
+                "kft_autoscaler_scale_events_total",
+                service="m", direction="down",
+            ) >= 1
+        finally:
+            watcher.cancel()
+            await asc.stop()
+            await client.close()
+            await source.close()
+            await fleet.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_ring_remap_prefix_kv_transfer_recovers_hit_rate():
+    """ISSUE 11 acceptance, part 2: after a scale-up remaps the hash
+    ring, the cold replica has already pulled the prefix entries it now
+    owns from the previous owner — a remapped prompt lands a prefix HIT
+    on it (kft_engine_prefix_hits_total) with 16 prompt tokens reused
+    instead of re-prefilled, and its token stream is byte-identical."""
+    import aiohttp
+    import jax
+
+    from kubeflow_tpu.serve.engine import LMEngineModel
+    from kubeflow_tpu.serve.model import BucketSpec
+
+    cfg, _, params = _tiny_lm()
+
+    async def run():
+        stops = []
+
+        async def launch(index):
+            m = LMEngineModel(
+                "m", None, config=cfg, max_batch=4, chunk_steps=2,
+                buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+                max_new_tokens=4, eos_id=cfg.vocab_size + 1,
+                watchdog=False, prefix_cache_entries=32,
+            )
+            m.load()
+            # identical weights on every replica — transferred KV is
+            # only valid if peers computed it with the same parameters
+            m._params = jax.device_put(params)
+            m.engine.stop()
+            m.engine = m._make_engine().start()
+            ms = ModelServer([m], http_port=0)
+            srv, url = await _test_server(ms)
+
+            async def stop():
+                await srv.close()
+                m.unload()
+
+            stops.append(stop)
+            return url, stop
+
+        fleet = ReplicaFleet("m", launch, model="m")
+        session = aiohttp.ClientSession()
+
+        async def predict(url, ids):
+            async with session.post(
+                f"{url}/v1/models/m:predict",
+                json={"instances": [{"input_ids": ids}]},
+            ) as r:
+                assert r.status == 200, await r.text()
+                return (await r.json())["predictions"][0]
+
+        async def metrics(url):
+            async with session.get(f"{url}/metrics") as r:
+                return parse_prom_text(await r.text())
+
+        try:
+            await fleet.scale_to(1)
+            url_a = fleet.urls()[0]
+            # distinct 17-token prompts → 12 stored 16-token entries on A
+            prompts = [[2 + (7 * i + j) % 60 for j in range(17)]
+                       for i in range(12)]
+            outs_a = {}
+            for i, p in enumerate(prompts):
+                outs_a[i] = await predict(url_a, p)
+            m_a = await metrics(url_a)
+            assert metric_sum(m_a, "kft_engine_prefix_entries") == 12
+
+            # scale up: the fleet pulls B's ring share from A BEFORE B
+            # takes traffic
+            await fleet.scale_to(2)
+            url_b = next(u for u in fleet.urls() if u != url_a)
+            ring = HashRing(tuple(sorted((url_a, url_b))))
+            owned_by_b = [
+                i for i, p in enumerate(prompts)
+                if ring.pick(prefix_affinity_key(p[:16])) == url_b
+            ]
+            assert owned_by_b, "no prompt remapped to B — ring fixture"
+            m_b = await metrics(url_b)
+            imported = metric_sum(m_b, "kft_engine_prefix_imported_total")
+            assert imported == len(owned_by_b)
+            assert fleet.stats["kv_entries_moved"] == len(owned_by_b)
+            assert _metric(
+                "kft_autoscaler_kv_transfers_total", service="m"
+            ) >= len(owned_by_b)
+
+            # remapped prompts served by B: prefix HITS on transferred
+            # KV, identical tokens, no full re-prefill
+            for i in owned_by_b:
+                out_b = await predict(url_b, prompts[i])
+                assert out_b == outs_a[i], (out_b, outs_a[i])
+            m_b = await metrics(url_b)
+            hits = metric_sum(m_b, "kft_engine_prefix_hits_total")
+            reused = metric_sum(
+                m_b, "kft_engine_prefix_tokens_reused_total"
+            )
+            assert hits == len(owned_by_b)
+            assert reused == 16 * len(owned_by_b)
+
+            # scale-down evacuates the leaver's entries to the survivor
+            await fleet.scale_to(1)
+            assert fleet.urls() == [url_a]
+            assert fleet.stats["stopped"] == 1
+        finally:
+            await fleet.close()
+            await session.close()
+
+    asyncio.run(run())
