@@ -47,6 +47,7 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
         "kubeflow_tpu/train/loop.py",
         "kubeflow_tpu/train/prefetch.py",
         "kubeflow_tpu/serve/engine.py",
+        "kubeflow_tpu/ops/paged_attention.py",
     ),
     # Supervision clocks must survive wall-clock jumps (NTP step, VM
     # migration): grace/staleness/progress math is monotonic-only here.

@@ -35,6 +35,11 @@ from kubeflow_tpu.core.collectives import shard_map
 
 from kubeflow_tpu.core.mesh import Axis, current_mesh
 from kubeflow_tpu.ops.flash_attention import flash_attention, reference_attention
+from kubeflow_tpu.ops.paged_attention import (
+    dequantize_kv,
+    paged_attention,
+    quantize_kv,
+)
 from kubeflow_tpu.parallel.expert import MoEConfig, moe_ffn
 from kubeflow_tpu.parallel.ring_attention import ring_attention_local
 from kubeflow_tpu.parallel.ulysses import ulysses_attention_local
@@ -238,6 +243,8 @@ class Attention(nn.Module):
         page_table=None,
         page_size=None,
         page_write_ok=None,
+        paged_attn_impl="gather",
+        kv_quant="none",
     ):
         cfg = self.cfg
         B, S, _ = x.shape
@@ -282,28 +289,91 @@ class Attention(nn.Module):
                 )
                 flat_w = jnp.where(page_write_ok, flat_w, scratch)
             idx = flat_w.reshape(-1)
-            K = layer_cache["k"].at[:, idx, :].set(
-                k.astype(layer_cache["k"].dtype)
-                .transpose(1, 0, 2, 3).reshape(Hkv, B * S, D)
-            )
-            V = layer_cache["v"].at[:, idx, :].set(
-                v.astype(layer_cache["v"].dtype)
-                .transpose(1, 0, 2, 3).reshape(Hkv, B * S, D)
-            )
-            new_cache = {"k": K, "v": V}
-            # gather each row's first W logical tokens back out
-            j = jnp.arange(W)
-            flat_r = (
-                page_table[:, j // P] * P + (j % P)[None, :]
-            ).reshape(-1)                                          # (B*W,)
-            Kg = K[:, flat_r, :].reshape(Hkv, B, W, D).transpose(1, 0, 2, 3)
-            Vg = V[:, flat_r, :].reshape(Hkv, B, W, D).transpose(1, 0, 2, 3)
-            mask = j[None, None, :] <= positions[:, :, None]       # (B,S,W)
-            if cfg.attn_window is not None:
-                mask &= j[None, None, :] > (
-                    positions[:, :, None] - cfg.attn_window
+            if kv_quant == "int8":
+                # quantize-on-write: per-token-per-head symmetric int8
+                # codes + f32 scales ride the same scatter indices (see
+                # ops/paged_attention.py for why NOT per-page scales)
+                kq, ks = quantize_kv(k)                # codes (B,Hkv,S,D)
+                vq, vs = quantize_kv(v)                # scales (B,Hkv,S)
+                K = layer_cache["k"].at[:, idx, :].set(
+                    kq.transpose(1, 0, 2, 3).reshape(Hkv, B * S, D)
                 )
-            o = _grouped_cache_attention(q, Kg, Vg, mask, groups)
+                V = layer_cache["v"].at[:, idx, :].set(
+                    vq.transpose(1, 0, 2, 3).reshape(Hkv, B * S, D)
+                )
+                Ks = layer_cache["k_scale"].at[:, idx].set(
+                    ks.transpose(1, 0, 2).reshape(Hkv, B * S)
+                )
+                Vs = layer_cache["v_scale"].at[:, idx].set(
+                    vs.transpose(1, 0, 2).reshape(Hkv, B * S)
+                )
+                new_cache = {"k": K, "v": V, "k_scale": Ks, "v_scale": Vs}
+                # quantization-error telemetry: a no-op (XLA-dead) unless
+                # the caller requests mutable=["quant_stats"] — the engine
+                # does so only in its suffix-prefill program
+                kf, vf = k.astype(jnp.float32), v.astype(jnp.float32)
+                err = (
+                    jnp.sum(jnp.abs(dequantize_kv(kq, ks) - kf))
+                    + jnp.sum(jnp.abs(dequantize_kv(vq, vs) - vf))
+                )
+                den = jnp.sum(jnp.abs(kf)) + jnp.sum(jnp.abs(vf))
+                self.sow("quant_stats", "kv_quant_err", jnp.stack([err, den]))
+            elif kv_quant == "none":
+                K = layer_cache["k"].at[:, idx, :].set(
+                    k.astype(layer_cache["k"].dtype)
+                    .transpose(1, 0, 2, 3).reshape(Hkv, B * S, D)
+                )
+                V = layer_cache["v"].at[:, idx, :].set(
+                    v.astype(layer_cache["v"].dtype)
+                    .transpose(1, 0, 2, 3).reshape(Hkv, B * S, D)
+                )
+                new_cache = {"k": K, "v": V}
+            else:
+                raise ValueError(f"unknown kv_quant {kv_quant!r}")
+            if paged_attn_impl == "kernel":
+                # Pallas kernel read: the block table rides the grid as a
+                # scalar-prefetch operand and the pallas_call pipeline
+                # stages pages HBM→VMEM. Assumes contiguous span
+                # positions (positions[b] == positions[b, 0] + arange(S)),
+                # which holds for every engine caller — decode steps, the
+                # speculative verify span, and chunked-prefill pieces.
+                o = paged_attention(
+                    q,
+                    new_cache["k"],
+                    new_cache["v"],
+                    page_table,
+                    positions[:, 0],
+                    page_size=P,
+                    window=cfg.attn_window,
+                    k_scale=new_cache.get("k_scale"),
+                    v_scale=new_cache.get("v_scale"),
+                    interpret=cfg.interpret_kernels,
+                )
+            elif paged_attn_impl == "gather":
+                # gather each row's first W logical tokens back out
+                j = jnp.arange(W)
+                flat_r = (
+                    page_table[:, j // P] * P + (j % P)[None, :]
+                ).reshape(-1)                                      # (B*W,)
+                Kg = K[:, flat_r, :].reshape(Hkv, B, W, D).transpose(1, 0, 2, 3)
+                Vg = V[:, flat_r, :].reshape(Hkv, B, W, D).transpose(1, 0, 2, 3)
+                if kv_quant == "int8":
+                    # dequantize with the SAME broadcast multiply the
+                    # kernel uses, so gather/kernel parity holds
+                    Ksg = Ks[:, flat_r].reshape(Hkv, B, W).transpose(1, 0, 2)
+                    Vsg = Vs[:, flat_r].reshape(Hkv, B, W).transpose(1, 0, 2)
+                    Kg = dequantize_kv(Kg, Ksg)
+                    Vg = dequantize_kv(Vg, Vsg)
+                mask = j[None, None, :] <= positions[:, :, None]   # (B,S,W)
+                if cfg.attn_window is not None:
+                    mask &= j[None, None, :] > (
+                        positions[:, :, None] - cfg.attn_window
+                    )
+                o = _grouped_cache_attention(q, Kg, Vg, mask, groups)
+            else:
+                raise ValueError(
+                    f"unknown paged_attn_impl {paged_attn_impl!r}"
+                )
         elif layer_cache is not None:
             # Autoregressive decode path (SURVEY.md §2.2 "vLLM backend"
             # analog): keys/values accumulate in an explicit functional
@@ -510,6 +580,8 @@ class Block(nn.Module):
         page_table=None,
         page_size=None,
         page_write_ok=None,
+        paged_attn_impl="gather",
+        kv_quant="none",
     ):
         cfg = self.cfg
         new_cache = None
@@ -520,6 +592,7 @@ class Block(nn.Module):
                 layer_cache=layer_cache, cache_index=cache_index,
                 kv_mask=kv_mask, page_table=page_table,
                 page_size=page_size, page_write_ok=page_write_ok,
+                paged_attn_impl=paged_attn_impl, kv_quant=kv_quant,
             )
         else:
             h = Attention(cfg, name="attn")(attn_in, positions, segment_ids)
@@ -555,6 +628,8 @@ class TransformerLM(nn.Module):
         page_table=None,
         page_size=None,
         page_write_ok=None,
+        paged_attn_impl="gather",
+        kv_quant="none",
     ):
         """Training/scoring: ``(tokens) -> logits``. Autoregressive serving:
         pass ``cache`` (from :func:`init_kv_cache`) + ``cache_index`` →
@@ -602,6 +677,8 @@ class TransformerLM(nn.Module):
                     page_table=page_table,
                     page_size=page_size,
                     page_write_ok=page_write_ok,
+                    paged_attn_impl=paged_attn_impl,
+                    kv_quant=kv_quant,
                 )
             else:
                 x = block(x, positions, segment_ids)
@@ -630,14 +707,32 @@ def init_kv_cache(
 
 
 def init_paged_kv_cache(
-    cfg: TransformerConfig, pool_tokens: int, dtype: Any | None = None
+    cfg: TransformerConfig,
+    pool_tokens: int,
+    dtype: Any | None = None,
+    kv_quant: str = "none",
 ) -> dict:
     """Zeroed PAGED decode cache: one flat (kv_heads, pool_tokens,
     head_dim) K and V per layer, shared by every row through a block table
     (serve/paging.py). HBM is billed per resident TOKEN, not per
-    (row × max_seq) rectangle."""
+    (row × max_seq) rectangle. ``kv_quant="int8"`` stores int8 codes plus
+    per-(kv_head, token) f32 ``k_scale``/``v_scale`` side arrays — the
+    pool arrays themselves cost a quarter of f32 (half of bf16), scales
+    add ~1/head_dim on top."""
     dtype = dtype or cfg.dtype
     shape = (cfg.kv_heads, pool_tokens, cfg.head_dim)
+    if kv_quant == "int8":
+        return {
+            f"layers_{i}": {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:2], jnp.float32),
+                "v_scale": jnp.zeros(shape[:2], jnp.float32),
+            }
+            for i in range(cfg.n_layers)
+        }
+    if kv_quant != "none":
+        raise ValueError(f"unknown kv_quant {kv_quant!r}")
     return {
         f"layers_{i}": {
             "k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)

@@ -163,6 +163,12 @@ ENGINE_PREFIX_EXPORTED_TOTAL = "kft_engine_prefix_exported_total"
 ENGINE_SPEC_PROPOSED_TOTAL = "kft_engine_spec_proposed_total"
 ENGINE_SPEC_ACCEPTED_TOTAL = "kft_engine_spec_accepted_total"
 ENGINE_SPEC_ACCEPTANCE = "kft_engine_spec_acceptance"
+#: int8 KV-cache quantization (ops/paged_attention.py): EWMA of the
+#: mean-abs relative quantization error measured at prefill writes
+ENGINE_KV_QUANT_ERROR = "kft_engine_kv_quant_error"
+#: gauge — 1 while the engine's paged read path runs the Pallas kernel
+#: (LMEngineConfig paged_attn_impl="kernel"), 0 for the XLA gather
+ENGINE_PAGED_ATTN_KERNEL = "kft_engine_paged_attn_kernel"
 
 # -- serving SRE layer (serve/deadline.py, serve/watchdog.py) ------------ #
 

@@ -192,3 +192,116 @@ def sweep_blocks(
             json.dump(table, f, indent=1, sort_keys=True)
         reset_table_cache()
     return results
+
+
+# --------------------------------------------------------------------- #
+# paged decode-attention page size (ops/paged_attention.py)
+# --------------------------------------------------------------------- #
+
+def select_paged_page_size(head_dim: int, default: int = 64) -> int:
+    """Measured page size for the paged decode-attention kernel. One kv
+    grid step stages one pool page HBM→VMEM, so the sweepable "block
+    size" IS the engine's ``page_size``. Table section ``paged:{head_dim}``
+    (the kv tile is (page, head_dim) — sequence length doesn't change its
+    VMEM footprint). Falls back to the engine's historical 64-token
+    default when no sweep has landed."""
+    entry = _table().get(f"paged:{head_dim}")
+    if entry:
+        return int(entry[0]) if isinstance(entry, (list, tuple)) else int(entry)
+    return default
+
+
+def sweep_paged_pages(
+    *,
+    batch: int = 8,
+    kv_heads: int = 4,
+    groups: int = 2,
+    head_dim: int = 64,
+    seq_tokens: int = 1024,
+    span: int = 1,
+    candidates: tuple[int, ...] = (32, 64, 128, 256),
+    reps: int = 3,
+    write: bool = True,
+    table_path: str | None = None,
+) -> dict:
+    """Time the paged decode kernel per candidate page size on the LIVE
+    backend (chained two-point, same discipline as :func:`sweep_blocks`);
+    returns {"page_size": best, "ms": ..., "all": {...}} and (optionally)
+    writes the winner to the ``paged:{head_dim}`` table entry. Each
+    candidate gets its own synthetic pool + block table covering
+    ``seq_tokens`` resident tokens per row."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.ops.paged_attention import paged_attention
+
+    heads = kv_heads * groups
+    per: dict[str, float] = {}
+    for P in candidates:
+        if seq_tokens % P:
+            continue
+        w = seq_tokens // P                      # pages per row
+        n_pages = 1 + batch * w                  # + scratch page 0
+        pool_tokens = n_pages * P
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(
+            kq, (batch, heads, span, head_dim), jnp.bfloat16
+        )
+        k_pool = jax.random.normal(
+            kk, (kv_heads, pool_tokens, head_dim), jnp.bfloat16
+        )
+        v_pool = jax.random.normal(
+            kv, (kv_heads, pool_tokens, head_dim), jnp.bfloat16
+        )
+        table_np = (
+            1 + np.arange(batch * w, dtype=np.int32).reshape(batch, w)
+        )
+        tbl = jnp.asarray(table_np)
+        pos0 = jnp.full((batch,), seq_tokens - span, jnp.int32)
+
+        fn = jax.jit(
+            lambda q, kp, vp, t, p0, _P=P: paged_attention(
+                q, kp, vp, t, p0, page_size=_P
+            )
+        )
+        out = fn(q, k_pool, v_pool, tbl, pos0)  # compile
+        np.asarray(out[0, 0, 0])                # host-transfer sync
+
+        def run(n):
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(n):
+                o = fn(q, k_pool, v_pool, tbl, pos0)
+            np.asarray(o[0, 0, 0])
+            return time.perf_counter() - t0
+
+        est = []
+        for _ in range(reps):
+            t_small, t_large = run(5), run(20)
+            est.append((t_large - t_small) / 15)
+        med = sorted(est)[len(est) // 2]
+        if med <= 0:
+            continue  # timing noise won — never crown an invalid sample
+        per[str(P)] = round(med * 1e3, 4)
+    if not per:
+        return {}
+    best = min(per, key=per.get)
+    result = {"page_size": int(best), "ms": per[best], "all": per}
+    if write:
+        path = table_path or os.environ.get(
+            "KFT_FLASH_BLOCKS_FILE", _TABLE_PATH
+        )
+        try:
+            with open(path) as f:
+                table = json.load(f)
+        except (OSError, ValueError):
+            table = {}
+        table[f"paged:{head_dim}"] = [int(best)]
+        with open(path, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        reset_table_cache()
+    return result

@@ -1,0 +1,297 @@
+"""Pallas paged decode-attention: the vLLM PagedAttention analog, TPU-form.
+
+The engine's paged KV pool (`serve/paging.py`) stores each layer's keys
+and values as ONE flat token axis — ``(kv_heads, pool_tokens, head_dim)``
+— and a row's logical token ``j`` lives at flat slot
+``table[row, j // P] * P + j % P``.  The in-graph read path gathers the
+row's whole pow2-bucketed window back into a dense ``(B, H, W, D)``
+tensor and runs masked softmax attention on it (XLA gather; see
+`models/transformer.py`).  This module is the kernel form of that read:
+the block table rides the grid as a **scalar-prefetch operand**, so each
+kv grid step's BlockSpec index map picks the page to stage —
+
+    ``lambda b, h, i, tbl, pos0: (h, tbl[b, i], 0)``
+
+— and the pallas_call pipeline itself performs the HBM→VMEM page fetch
+(double-buffered against compute), fused with online-softmax attention
+over the staged page.  One kv block == one pool page, which is why the
+sweepable "block size" for this kernel IS the engine's ``page_size``
+(`ops/flash_tuning.py` ``select_paged_page_size``).
+
+Span support: queries are a contiguous (K+1)-position speculative verify
+span (or a prefill piece) starting at per-row position ``pos0[b]`` —
+query s sits at absolute position ``pos0[b] + s``.  The in-span causal
+mask that `serve/generate.py:decode_span_kv_mask` builds for the dense
+path falls out of pure position arithmetic inside the tile mask here
+(key position ``i*P + lane`` is visible to query s iff it is ``<=
+pos0 + s`` and inside the sliding window), so speculative verify needs
+no separate program.  GQA: the kv-head grid axis stages each kv head's
+page once and all ``H // kv_heads`` query heads in the group attend to
+it in-tile.
+
+int8 KV: ``quantize_kv`` produces per-token-per-head symmetric int8
+codes plus an f32 scale per (kv_head, token) vector; the kernel
+dequantizes in-register after the page lands in VMEM, so HBM traffic and
+pool bytes halve vs bf16 (quarter vs f32).  Per-token scales — not
+per-page — because pool pages fill incrementally across decode steps:
+a page-granular scale would force lossy requantization of codes already
+written by earlier chunks.
+
+Numerics: scores and the softmax accumulate in f32 exactly like the
+gather path's f32 einsum; the online rescaling uses the flash-attention
+idiom (`ops/flash_attention.py`) with one hardening — masked lanes
+contribute exactly 0 via ``where(mask, exp(s - m), 0)`` so a fully
+masked page (sliding-window skip, scratch-page read for a dead row)
+can never poison the accumulator.  Everything runs under
+``interpret=True`` on CPU; the engine matrix pins greedy token streams
+byte-identical to the gather path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams"):  # jax < 0.5 spelling
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+NEG_INF = -1e30  # matches the gather path's masked-score fill
+
+
+# --------------------------------------------------------------------- #
+# int8 KV quantization helpers (shared by the write scatter and the
+# gather-impl read so both dequantize with bit-identical math)
+# --------------------------------------------------------------------- #
+
+def quantize_kv(x: jax.Array):
+    """Symmetric per-vector int8 quantization over the trailing head_dim.
+
+    ``x`` is ``(..., D)``; returns ``(codes int8 (..., D), scales f32
+    (...,))`` with ``codes = clip(round(x / scale), -127, 127)`` and
+    ``scale = max(|x|) / 127`` per vector (floored so all-zero vectors
+    quantize to zeros with a harmless tiny scale).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    codes = jnp.clip(jnp.round(xf / scale[..., None]), -127.0, 127.0)
+    return codes.astype(jnp.int8), scale
+
+
+def dequantize_kv(codes: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_kv`: ``codes (..., D) * scale (...,)``."""
+    return codes.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
+# --------------------------------------------------------------------- #
+# kernel
+# --------------------------------------------------------------------- #
+
+def _paged_attn_kernel(
+    # scalar prefetch (SMEM)
+    tbl_ref,    # (B, W) int32 page table
+    pos0_ref,   # (B,) int32 span start positions
+    # VMEM blocks
+    q_ref,      # (1, 1, G*S, D) — queries, GQA group folded into the span axis
+    k_ref,      # (1, P, D) — the page picked by the index map
+    v_ref,      # (1, P, D)
+    ks_ref,     # (1, P) f32 or None
+    vs_ref,     # (1, P) f32 or None
+    o_ref,      # (1, 1, G*S, D)
+    # VMEM scratch
+    acc_ref,    # (G*S, D) f32
+    m_ref,      # (G*S, 1) f32
+    l_ref,      # (G*S, 1) f32
+    *,
+    scale: float | None,
+    window: int | None,
+    page_size: int,
+    groups: int,
+    span: int,
+    num_pages: int,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    P, G, S = page_size, groups, span
+    GS = G * S
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos0 = pos0_ref[b]  # SMEM scalar
+    first = i * P
+    # skip pages wholly past the span's last query...
+    run = first <= pos0 + S - 1
+    if window is not None:
+        # ...and, when windowed, pages wholly before the earliest
+        # query's window start
+        run = run & (first + P - 1 >= pos0 - window + 1)
+
+    @pl.when(run)
+    def _body():
+        d = q_ref.shape[-1]
+        q = q_ref[0, 0].astype(jnp.float32)  # (GS, D)
+        k = k_ref[0].astype(jnp.float32)  # (P, D)
+        v = v_ref[0].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[0][:, None]
+            v = v * vs_ref[0][:, None]
+        if scale is None:
+            mult = 1.0 / jnp.sqrt(jnp.float32(d))  # gather-path spelling
+        else:
+            mult = jnp.float32(scale)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * mult  # (GS, P)
+        # absolute positions: row r of the GS axis is query s = r % S at
+        # position pos0 + s; lane j is key position first + j
+        kpos = first + jax.lax.broadcasted_iota(jnp.int32, (GS, P), 1)
+        qpos = pos0 + (jax.lax.broadcasted_iota(jnp.int32, (GS, P), 0) % S)
+        mask = kpos <= qpos
+        if window is not None:
+            mask &= kpos > qpos - window
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # masked lanes contribute EXACTLY 0 even when the whole tile is
+        # masked (exp(s - m_cur) would be exp(0)=1 garbage at m==NEG_INF)
+        p = jnp.where(mask, jnp.exp(s - m_cur), 0.0)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_cur
+
+    @pl.when(i == num_pages - 1)
+    def _finish():
+        l = l_ref[...]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "window", "scale", "interpret"),
+)
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    pos0: jax.Array,
+    *,
+    page_size: int,
+    window: int | None = None,
+    scale: float | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention over a paged KV pool, addressed by block table.
+
+    Args:
+      q: ``(B, H, S, D)`` queries — a contiguous span of S positions per
+        row (S=1 plain decode, S=K+1 speculative verify, S=piece for
+        chunked prefill).
+      k_pool / v_pool: ``(kv_heads, pool_tokens, D)`` flat pools
+        (int8 codes when quantized).
+      page_table: ``(B, W_pages)`` int32 — page ordinal → pool page.
+      pos0: ``(B,)`` int32 — absolute position of each row's first query
+        (query s sits at ``pos0 + s``).
+      page_size: tokens per page; one kv grid step stages one page.
+      window: optional sliding-window width (same semantics as the
+        gather path's ``attn_window``).
+      scale: score multiplier; defaults to ``1/sqrt(D)`` computed in f32
+        exactly like the gather path.
+      k_scale / v_scale: ``(kv_heads, pool_tokens)`` f32 per-token
+        dequant scales; both or neither.
+      interpret: run the Pallas interpreter (CPU-verifiable).
+
+    Returns ``(B, H, S, D)`` in q's dtype.
+    """
+    B, H, S, D = q.shape
+    Hkv, T, Dk = k_pool.shape
+    if Dk != D or v_pool.shape != k_pool.shape:
+        raise ValueError(f"pool shapes {k_pool.shape}/{v_pool.shape} vs D={D}")
+    if H % Hkv:
+        raise ValueError(f"{H} query heads not a multiple of {Hkv} kv heads")
+    if T % page_size:
+        raise ValueError(f"pool_tokens {T} not a multiple of page {page_size}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    quant = k_scale is not None
+    if quant and k_scale.shape != (Hkv, T):
+        raise ValueError(f"scale shape {k_scale.shape} != {(Hkv, T)}")
+    G = H // Hkv
+    W = page_table.shape[1]
+
+    kernel = functools.partial(
+        _paged_attn_kernel,
+        scale=scale,
+        window=window,
+        page_size=page_size,
+        groups=G,
+        span=S,
+        num_pages=W,
+    )
+    if not quant:
+        # keep the kernel signature uniform: drop the scale refs
+        kernel = functools.partial(_strip_scale_refs, kernel)
+
+    # fold the GQA group into the span axis: head h = hkv*G + g maps to
+    # row g*S + s of the (G*S) query axis for kv head hkv
+    qg = q.reshape(B, Hkv, G * S, D)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, G * S, D), lambda b, h, i, tbl, p0: (b, h, 0, 0)),
+        pl.BlockSpec((1, page_size, D), lambda b, h, i, tbl, p0: (h, tbl[b, i], 0)),
+        pl.BlockSpec((1, page_size, D), lambda b, h, i, tbl, p0: (h, tbl[b, i], 0)),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, page_size), lambda b, h, i, tbl, p0: (h, tbl[b, i])),
+            pl.BlockSpec((1, page_size), lambda b, h, i, tbl, p0: (h, tbl[b, i])),
+        ]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, W),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, G * S, D), lambda b, h, i, tbl, p0: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G * S, D), jnp.float32),
+            pltpu.VMEM((G * S, 1), jnp.float32),
+            pltpu.VMEM((G * S, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G * S, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32), pos0.astype(jnp.int32), *operands
+    )
+    return out.reshape(B, H, S, D)
+
+
+def _strip_scale_refs(kernel, tbl_ref, pos0_ref, q_ref, k_ref, v_ref,
+                      o_ref, acc_ref, m_ref, l_ref):
+    kernel(tbl_ref, pos0_ref, q_ref, k_ref, v_ref, None, None,
+           o_ref, acc_ref, m_ref, l_ref)

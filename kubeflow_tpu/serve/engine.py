@@ -105,7 +105,21 @@ class LMEngineConfig:
     forwards the same token stream costs. ``spec_ngram``: the match
     window the drafter keys on (>= 1). Dense mode reserves K scratch
     slots of KV headroom per row, so admission requires
-    ``layout + max_new_tokens + K <= max_seq`` when spec is on."""
+    ``layout + max_new_tokens + K <= max_seq`` when spec is on.
+
+    ``paged_attn_impl``: how the paged read path runs — ``"gather"``
+    (default, in-graph XLA gather + masked softmax) or ``"kernel"``
+    (ops/paged_attention.py: Pallas decode attention fetching K/V pages
+    through the block table, online softmax fused; on CPU it runs the
+    Pallas interpreter when ``TransformerConfig.interpret_kernels`` is
+    set). Greedy token streams are byte-identical between the two.
+    ``kv_quant``: ``"none"`` (default, byte-exact with the pre-quant
+    engine) or ``"int8"`` — per-(kv_head, token) symmetric int8 pool
+    with f32 scale side arrays, quantize-on-write / dequantize-on-read;
+    pool bytes per resident token halve vs bf16 (quarter vs f32). Both
+    knobs require paged mode (``kv_pool_tokens``). ``page_size=None``
+    selects the measured page size from ops/flash_tuning.py's table
+    (``paged:{head_dim}`` section, swept by scripts/chip_session.py)."""
 
     max_batch: int = 8
     max_seq: int = 256
@@ -121,10 +135,12 @@ class LMEngineConfig:
     mesh: Any = None
     rules: Any = None
     kv_pool_tokens: int | None = None
-    page_size: int = 64
+    page_size: int | None = 64
     pipeline_depth: int = 1
     spec_draft_tokens: int = 0
     spec_ngram: int = 3
+    paged_attn_impl: str = "gather"
+    kv_quant: str = "none"
 
 
 @dataclass
@@ -240,6 +256,32 @@ class LMEngine:
         #: speculative decode: K draft tokens verified per forward (0=off)
         self.spec_k = config.spec_draft_tokens
         self.spec_ngram = config.spec_ngram
+        if config.paged_attn_impl not in ("gather", "kernel"):
+            raise ValueError(
+                f"paged_attn_impl must be 'gather' or 'kernel'; "
+                f"got {config.paged_attn_impl!r}"
+            )
+        if config.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'none' or 'int8'; got {config.kv_quant!r}"
+            )
+        if kv_pool_tokens is None and (
+            config.paged_attn_impl != "gather" or config.kv_quant != "none"
+        ):
+            raise ValueError(
+                "paged_attn_impl='kernel' / kv_quant='int8' require paged "
+                "mode (set kv_pool_tokens)"
+            )
+        #: paged read path (gather | kernel) and KV pool precision
+        self.paged_attn_impl = config.paged_attn_impl
+        self.kv_quant = config.kv_quant
+        if page_size is None:
+            # measured page size from the on-chip sweep table (falls back
+            # to the 64-token default when no table entry exists — the
+            # byte-compat default)
+            from kubeflow_tpu.ops.flash_tuning import select_paged_page_size
+
+            page_size = select_paged_page_size(cfg.head_dim)
         if not cfg.causal:
             raise ValueError("LMEngine needs a causal TransformerConfig")
         from kubeflow_tpu.core.compcache import enable_compilation_cache
@@ -319,19 +361,32 @@ class LMEngine:
                 max_pages_per_row=-(-max_seq // page_size),
             )
             if self._cache_sharding is not None:
-                # pooled layout: heads are axis 0
+                # pooled layout: heads are axis 0. With int8 KV the tree
+                # mixes rank-3 pools and rank-2 scale arrays, so the
+                # sharding is a per-leaf tree (heads axis sharded in both)
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
 
-                self._cache_sharding = NamedSharding(
-                    self.mesh, P("model", None, None)
+                pool_sh = NamedSharding(self.mesh, P("model", None, None))
+                scale_sh = NamedSharding(self.mesh, P("model", None))
+                self._cache_sharding = jax.tree_util.tree_map(
+                    lambda l: scale_sh if l.ndim == 2 else pool_sh,
+                    jax.eval_shape(
+                        lambda: init_paged_kv_cache(
+                            cfg, kv_pool_tokens, kv_quant=self.kv_quant
+                        )
+                    ),
                 )
                 self.cache = jax.jit(
-                    lambda: init_paged_kv_cache(cfg, kv_pool_tokens),
+                    lambda: init_paged_kv_cache(
+                        cfg, kv_pool_tokens, kv_quant=self.kv_quant
+                    ),
                     out_shardings=self._cache_sharding,
                 )()
             else:
-                self.cache = init_paged_kv_cache(cfg, kv_pool_tokens)
+                self.cache = init_paged_kv_cache(
+                    cfg, kv_pool_tokens, kv_quant=self.kv_quant
+                )
         elif self._cache_sharding is not None:
             # allocate DIRECTLY in the sharded layout: materialising the
             # full tree on one device first would OOM exactly the
@@ -418,6 +473,10 @@ class LMEngine:
             # pre-initialized: /metrics iterates this dict from another
             # thread; a first-admission key INSERT would race it
             self.stats["kv_pages_used_peak"] = 0
+        if self.kv_quant == "int8":
+            # EWMA of mean-abs relative KV quantization error, measured by
+            # the suffix-prefill program (kft_engine_kv_quant_error)
+            self.overlap["kv_quant_error"] = 0.0
 
         # prefix cache (vLLM automatic-prefix-caching analog): completed
         # prompt prefills donate their KV, keyed by the prompt ids rounded
@@ -519,7 +578,9 @@ class LMEngine:
             }
             for name in cache
         }
-        return cache, tok, tok != self.eos_id
+        # trailing (2,) zero matches the paged twin's quant-error output so
+        # _advance_prefill unpacks one arity for both layouts
+        return cache, tok, tok != self.eos_id, jnp.zeros((2,), jnp.float32)
 
     def _implant_impl(self, cache, stored, row):
         """Copy a stored prefix's KV (1, kv_heads, n16, D per layer) into
@@ -548,17 +609,27 @@ class LMEngine:
             H, D = self.cfg.kv_heads, self.cfg.head_dim
             if self.paged:
                 P = self.page_size
+                quant = self.kv_quant == "int8"
 
                 def impl(cache, table_row):
                     j = jnp.arange(n16)
                     idx = table_row[j // P] * P + j % P
-                    return {
+                    out = {
                         name: {
                             "k": lc["k"][:, idx, :][None],
                             "v": lc["v"][:, idx, :][None],
                         }
                         for name, lc in cache.items()
                     }
+                    if quant:
+                        # int8 entries carry their per-token scales —
+                        # (1, kv_heads, n16) alongside the (1, kv_heads,
+                        # n16, D) codes — so an imported prefix dequants
+                        # identically on the receiving engine
+                        for name, lc in cache.items():
+                            out[name]["k_scale"] = lc["k_scale"][:, idx][None]
+                            out[name]["v_scale"] = lc["v_scale"][:, idx][None]
+                    return out
             else:
 
                 def impl(cache, row):
@@ -795,6 +866,8 @@ class LMEngine:
                 {"params": self.params}, x, cache=cache,
                 positions=positions, page_table=table,
                 page_size=self.page_size, page_write_ok=write_ok,
+                paged_attn_impl=self.paged_attn_impl,
+                kv_quant=self.kv_quant,
             )
             emitted, n_emit, n_acc = spec_accept(
                 lg, draft, draft_len, sub, temperature
@@ -845,16 +918,32 @@ class LMEngine:
         S = suffix.shape[1]
         positions = offset + jnp.arange(S)[None, :]          # (1, S)
         write_ok = (jnp.arange(S) < slen[:, None])           # (1, S)
-        logits, cache = self.model.apply(
-            {"params": self.params}, suffix, cache=cache,
+        kw = dict(
             positions=positions, page_table=table,
             page_size=self.page_size, page_write_ok=write_ok,
+            paged_attn_impl=self.paged_attn_impl, kv_quant=self.kv_quant,
         )
+        if self.kv_quant == "int8":
+            # the ONLY program that materializes the quantization-error
+            # telemetry the model sows: per-admission amortization, and
+            # the scan-carry chunk programs stay telemetry-free
+            (logits, cache), qs = self.model.apply(
+                {"params": self.params}, suffix, cache=cache,
+                mutable=["quant_stats"], **kw,
+            )
+            qerr = sum(
+                jax.tree_util.tree_leaves(qs["quant_stats"])
+            )                                                # (2,) abs, den
+        else:
+            logits, cache = self.model.apply(
+                {"params": self.params}, suffix, cache=cache, **kw,
+            )
+            qerr = jnp.zeros((2,), jnp.float32)
         last = jnp.take_along_axis(
             logits, (slen - 1)[:, None, None], axis=1
         )[:, 0]
         tok = _sample(last, rng, temperature[None])[0]
-        return cache, tok, tok != self.eos_id
+        return cache, tok, tok != self.eos_id, qerr
 
     def _implant_paged(self, stored, row: int, n16: int):
         """Scatter a stored prefix (1, kv_heads, n16, D per layer — the
@@ -863,11 +952,12 @@ class LMEngine:
         fn = self._implant_jits.get(n16)
         if fn is None:
             P = self.page_size
+            quant = self.kv_quant == "int8"
 
             def impl(cache, stored, table_row):
                 j = jnp.arange(n16)
                 idx = table_row[j // P] * P + j % P
-                return {
+                out = {
                     name: {
                         "k": cache[name]["k"].at[:, idx, :].set(
                             stored[name]["k"][0].astype(
@@ -882,6 +972,19 @@ class LMEngine:
                     }
                     for name in cache
                 }
+                if quant:
+                    for name in cache:
+                        out[name]["k_scale"] = (
+                            cache[name]["k_scale"].at[:, idx].set(
+                                stored[name]["k_scale"][0]
+                            )
+                        )
+                        out[name]["v_scale"] = (
+                            cache[name]["v_scale"].at[:, idx].set(
+                                stored[name]["v_scale"][0]
+                            )
+                        )
+                return out
 
             fn = self._implant_jits[n16] = jax.jit(
                 impl, donate_argnums=(0,)
@@ -914,6 +1017,8 @@ class LMEngine:
                 page_table=table,
                 page_size=self.page_size,
                 page_write_ok=live[:, None],
+                paged_attn_impl=self.paged_attn_impl,
+                kv_quant=self.kv_quant,
             )
             nxt = _sample(lg[:, 0], sub, temperature)
             valid = live & (nxt != self.eos_id)
@@ -1505,7 +1610,7 @@ class LMEngine:
         self._rng, sub = jax.random.split(self._rng)
         if self.paged:
             pages_w = self._pages_w(base + i * C + C)
-            self.cache, tok, valid = self._suffix_prefill(
+            self.cache, tok, valid, qerr = self._suffix_prefill(
                 self.cache,
                 jnp.asarray(piece),
                 jnp.asarray([len(piece_ids)], np.int32),
@@ -1515,7 +1620,7 @@ class LMEngine:
                 sub,
             )
         else:
-            self.cache, tok, valid = self._suffix_prefill(
+            self.cache, tok, valid, qerr = self._suffix_prefill(
                 self.cache,
                 jnp.asarray(piece),
                 jnp.asarray([len(piece_ids)], np.int32),
@@ -1524,6 +1629,12 @@ class LMEngine:
                 jnp.float32(req.temperature),
                 sub,
             )
+        if self.kv_quant == "int8":
+            # same inline sync budget as the final piece's int(tok) below:
+            # prefill is synchronous by design (one row, host-driven)
+            e, d = float(qerr[0]), float(qerr[1])
+            if d > 0:
+                self._ewma("kv_quant_error", e / d)
         self.stats["prefill_pieces"] += 1
         st["piece"] = i + 1
         if not final:
@@ -1950,12 +2061,14 @@ class LMEngine:
                 sel = sel[-limit:]  # OrderedDict tail = most recently used
         out = []
         for key, stored in sel:
+            # generic over the per-layer dict: int8 entries additionally
+            # carry k_scale/v_scale arrays alongside the codes
             out.append((
                 key,
                 {
                     name: {
-                        "k": np.asarray(lc["k"]),  # kft: noqa[jax-sync] — peer-transfer export runs on an HTTP executor thread, never the scheduler loop
-                        "v": np.asarray(lc["v"]),  # kft: noqa[jax-sync] — same executor-thread D2H; the lock was released before this sync
+                        which: np.asarray(arr)  # kft: noqa[jax-sync] — peer-transfer export runs on an HTTP executor thread (lock already released), never the scheduler loop
+                        for which, arr in lc.items()
                     }
                     for name, lc in stored.items()
                 },
@@ -1974,6 +2087,15 @@ class LMEngine:
             return 0
         H, D = self.cfg.kv_heads, self.cfg.head_dim
         layer_names = set(self.cache)
+        # mixed-quantization rejection: an int8 engine's entries carry
+        # k_scale/v_scale (and int8 codes) — a float engine must not
+        # ingest them (it would attend to raw codes), and vice versa an
+        # int8 engine cannot use float entries without a scale. The key
+        # SET is the wire-level discriminator.
+        quant = self.kv_quant == "int8"
+        want_keys = (
+            {"k", "v", "k_scale", "v_scale"} if quant else {"k", "v"}
+        )
         prepared = []
         for key, tree in entries:
             key = tuple(int(t) for t in key)
@@ -1988,8 +2110,17 @@ class LMEngine:
             if set(tree) != layer_names:
                 continue
             want = (1, H, n16, D)
+            want_scale = (1, H, n16)
+            if any(set(lc) != want_keys for lc in tree.values()):
+                continue
             if any(
                 np.shape(lc.get("k")) != want or np.shape(lc.get("v")) != want
+                for lc in tree.values()
+            ):
+                continue
+            if quant and any(
+                np.shape(lc["k_scale"]) != want_scale
+                or np.shape(lc["v_scale"]) != want_scale
                 for lc in tree.values()
             ):
                 continue
@@ -1997,8 +2128,8 @@ class LMEngine:
                 key,
                 {
                     name: {
-                        "k": jnp.asarray(lc["k"]),
-                        "v": jnp.asarray(lc["v"]),
+                        which: jnp.asarray(arr)
+                        for which, arr in lc.items()
                     }
                     for name, lc in tree.items()
                 },
@@ -2070,7 +2201,8 @@ class LMEngineModel(LMRuntimeModel):
         chunk_steps=8, prefix_cache_entries=0, prefix_cache_tokens=None,
         prefill_chunk=None, mesh=None, rules=None,
         kv_pool_tokens=None, page_size=64, pipeline_depth=1,
-        spec_draft_tokens=0, spec_ngram=3, watchdog=True,
+        spec_draft_tokens=0, spec_ngram=3,
+        paged_attn_impl="gather", kv_quant="none", watchdog=True,
         watchdog_interval_s=0.5, watchdog_wedge_factor=8.0,
         watchdog_min_wedge_s=30.0, **kwargs,
     ):
@@ -2087,6 +2219,8 @@ class LMEngineModel(LMRuntimeModel):
         self._engine_pipeline_depth = pipeline_depth
         self._engine_spec_draft = spec_draft_tokens
         self._engine_spec_ngram = spec_ngram
+        self._engine_paged_attn_impl = paged_attn_impl
+        self._engine_kv_quant = kv_quant
         # dense speculative decode reserves K scratch KV slots per row —
         # the default max_seq must include them or the largest bucket's
         # requests would be rejected at enqueue
@@ -2139,6 +2273,8 @@ class LMEngineModel(LMRuntimeModel):
             pipeline_depth=self._engine_pipeline_depth,
             spec_draft_tokens=self._engine_spec_draft,
             spec_ngram=self._engine_spec_ngram,
+            paged_attn_impl=self._engine_paged_attn_impl,
+            kv_quant=self._engine_kv_quant,
         )
 
     def restart_engine(self, err: Exception | None = None) -> LMEngine:

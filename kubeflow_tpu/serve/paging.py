@@ -112,12 +112,17 @@ class PageAllocator:
     def device_table(self, width: int):
         """Device-resident ``table[:, :width]``, re-uploaded only when the
         host table changed since the last upload at this width. The width
-        set is pow2-bucketed by the engine, so the memo stays small; stale
-        widths keep their old arrays (tiny int32 slabs) until re-read."""
+        set is pow2-bucketed by the engine, so the memo stays small; on a
+        miss, entries from older table versions are evicted first — a
+        long-lived engine with churning horizons would otherwise pin one
+        stale int32 slab per width it ever touched, forever."""
         import jax.numpy as jnp  # deferred: the allocator itself is host-only
 
         ver, arr = self._dev.get(width, (-1, None))
         if ver != self.version or arr is None:
+            self._dev = {
+                w: va for w, va in self._dev.items() if va[0] == self.version
+            }
             # snapshot, don't view: jnp.asarray of an aligned numpy
             # buffer is ZERO-COPY on the CPU backend, so the "device"
             # mirror would alias the live table and a later alloc/free

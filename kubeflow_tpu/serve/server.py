@@ -156,9 +156,12 @@ def _engine_collector(name: str, model):
 
 
 def encode_prefix_entries(entries) -> bytes:
-    """``[(key, {layer: {"k": np, "v": np}}), ...]`` → one npz blob. The
-    key list rides inside as JSON bytes so the payload is self-describing
-    (no side-channel headers to drift)."""
+    """``[(key, {layer: {"k": np, "v": np, ...}}), ...]`` → one npz blob.
+    Generic over the per-layer dict, so int8 entries' ``k_scale``/
+    ``v_scale`` arrays ride the same wire format (the receiving engine's
+    import validation keys off the key set). The key list rides inside as
+    JSON bytes so the payload is self-describing (no side-channel headers
+    to drift)."""
     import io
     import json
 
@@ -169,8 +172,8 @@ def encode_prefix_entries(entries) -> bytes:
     for i, (key, tree) in enumerate(entries):
         keys.append([int(t) for t in key])
         for layer, kv in tree.items():
-            arrays[f"{i}|{layer}|k"] = kv["k"]
-            arrays[f"{i}|{layer}|v"] = kv["v"]
+            for which, arr in kv.items():
+                arrays[f"{i}|{layer}|{which}"] = arr
     arrays["__keys__"] = np.frombuffer(
         json.dumps(keys).encode(), dtype=np.uint8
     )
@@ -923,6 +926,19 @@ class ModelServer:
                     lines.append(
                         f'{names.ENGINE_KV_PREFIX}{key}{{model="{name}"}} '
                         f"{val}"
+                    )
+                # paged read-path selection + KV quantization health
+                kernel_on = int(
+                    getattr(eng, "paged_attn_impl", "gather") == "kernel"
+                )
+                lines.append(
+                    f'{names.ENGINE_PAGED_ATTN_KERNEL}{{model="{name}"}} '
+                    f"{kernel_on}"
+                )
+                if ov is not None and "kv_quant_error" in ov:
+                    lines.append(
+                        f'{names.ENGINE_KV_QUANT_ERROR}{{model="{name}"}} '
+                        f'{ov["kv_quant_error"]:.6f}'
                     )
             # engine watchdog: trips by reason + supervised restarts (the
             # smoke/chaos assertions read these per-replica, so they must

@@ -8,8 +8,10 @@ timeout so a mid-stage wedge can never take down the stages after it or
 hang the caller:
 
   1. tests_chip/ (bf16 flash S512 fwd+bwd parity, engine-on-chip incl.
-     prefix reuse, block sweep + tuned parity)    [VERDICT item 2 gate]
-  2. flash block sweep at BERT + LM head dims, winners persisted to
+     prefix reuse, block sweep + tuned parity, compiled paged-attention
+     kernel parity + page sweep)                  [VERDICT item 2 gate]
+  2. flash block sweep at BERT + LM head dims PLUS the paged decode
+     kernel's page-size sweep, winners persisted to
      ops/flash_blocks_v5e.json (committed → every later run is tuned)
   3. python bench.py — full driver-format suite   [VERDICT item 1]
   4. BERT MFU batch/seq sweep (B32/64 × S128/512) [items 2+3 evidence]
@@ -75,13 +77,18 @@ def main() -> int:
 
     sweep_prog = (
         "import sys; sys.path.insert(0, %r)\n"
-        "from kubeflow_tpu.ops.flash_tuning import sweep_blocks\n"
+        "from kubeflow_tpu.ops.flash_tuning import (sweep_blocks,\n"
+        "    sweep_paged_pages)\n"
         "import json\n"
         "r64 = sweep_blocks(seq_lens=(128, 256, 512, 1024), head_dim=64)\n"
         "r128 = sweep_blocks(seq_lens=(256, 512), head_dim=128,\n"
         "                    candidates=((128,128),(128,256),(256,256)))\n"
+        "# paged decode kernel: the sweepable block size IS the engine's\n"
+        "# page_size (one kv grid step = one pool page HBM->VMEM)\n"
+        "rp = sweep_paged_pages(head_dim=64, seq_tokens=1024)\n"
         "print(json.dumps({'d64': {k: v for k, v in r64.items()},\n"
-        "                  'd128': {k: v for k, v in r128.items()}},\n"
+        "                  'd128': {k: v for k, v in r128.items()},\n"
+        "                  'paged_d64': rp},\n"
         "                 default=str))\n"
     ) % REPO
     report["stages"]["block_sweep"] = run_stage(

@@ -215,6 +215,62 @@ print(f"speculative decode OK: tokens={len(toks4)} identical, "
       f"forwards {chunks0}->{chunks4}, spec_accepted={accepted}")
 EOF
 
+echo "== paged kernel (interpret): byte-parity vs gather, int8 pool halved =="
+python - <<'EOF'
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig, TransformerLM,
+)
+from kubeflow_tpu.serve.engine import LMEngine  # noqa: E402
+
+cfg = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=1, n_heads=4, d_ff=64, causal=True,
+    max_seq_len=128, attn_impl="reference", dtype=jnp.float32,
+    interpret_kernels=True,  # CPU smoke: Mosaic interpreter, same semantics
+)
+model = TransformerLM(cfg)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+    "params"
+]
+prompts = [[3, 5, 7, 11, 13], [2, 4, 6]]
+
+
+def run(impl, quant="none"):
+    eng = LMEngine(
+        model, cfg, params, max_batch=2, max_seq=64, chunk_steps=4,
+        prefill_buckets=(16,), eos_id=cfg.vocab_size + 1,
+        kv_pool_tokens=16 * 10, page_size=16,
+        paged_attn_impl=impl, kv_quant=quant,
+    ).start()
+    try:
+        outs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        kv = sum(int(lc[w].nbytes)
+                 for lc in eng.cache.values() for w in ("k", "v"))
+        sc = sum(int(a.nbytes) for lc in eng.cache.values()
+                 for w, a in lc.items() if w.endswith("_scale"))
+    finally:
+        eng.stop()
+    return outs, kv, sc
+
+
+gather, kv_f32, sc_f32 = run("gather")
+kernel, _, _ = run("kernel")
+# the read-path swap is a layout change, not a numerics change
+assert kernel == gather, (kernel, gather)
+_, kv_int8, sc_int8 = run("gather", "int8")
+# int8 pool = 1/4 of f32 = 1/2 of the bf16 pool the chip serves from;
+# per-token-per-head f32 scales are the 1/head_dim overhead on top
+assert kv_int8 * 4 == kv_f32 and sc_f32 == 0, (kv_int8, kv_f32)
+assert sc_int8 == kv_int8 * 4 // (cfg.d_model // cfg.n_heads)
+print(f"paged kernel OK: byte-identical streams, pool {kv_f32}->{kv_int8} B "
+      f"(+{sc_int8} B scales)")
+EOF
+
 echo "== kill-and-resume: SIGTERM mid-train -> 143 -> exact-step resume =="
 python - <<'EOF'
 import os, re, signal, subprocess, sys, tempfile, time

@@ -70,6 +70,28 @@ def test_allocator_accounting():
                       max_pages_per_row=1)
 
 
+def test_device_table_memo_evicts_stale_widths():
+    """The device-mirror memo holds at most one entry per width, all
+    from the CURRENT table version — a long-lived engine with churning
+    horizons must not pin one stale int32 slab per width it ever
+    touched."""
+    a = PageAllocator(
+        pool_tokens=16 * 8, page_size=16, max_batch=4, max_pages_per_row=4
+    )
+    a.alloc(0, 2)
+    a.device_table(2)
+    a.device_table(4)
+    assert len(a._dev) == 2 and a.device_uploads == 2
+    a.alloc(1, 2)  # version bump → both memo entries are now stale
+    a.device_table(4)  # miss: evicts the stale pair, uploads one fresh
+    assert len(a._dev) == 1 and a.device_uploads == 3
+    assert all(ver == a.version for ver, _ in a._dev.values())
+    a.device_table(2)
+    assert len(a._dev) == 2 and a.device_uploads == 4
+    a.device_table(2)  # hit: no upload, no eviction
+    assert len(a._dev) == 2 and a.device_uploads == 4
+
+
 # ------------------------------------------------------------------ parity
 
 

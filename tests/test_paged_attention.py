@@ -1,0 +1,454 @@
+"""Pallas paged decode-attention kernel (ops/paged_attention.py) + int8
+KV-cache quantization: the vLLM PagedAttention-kernel analog.
+
+Two contracts pinned here, in two layers:
+
+1. Kernel layer — ``paged_attention`` against a numpy oracle that walks
+   the block table by hand: GQA folding, sliding window, the (K+1)-wide
+   speculative verify span (in-span causal mask), int8 dequantize-in-
+   kernel, and the pos0=0 first-token edge. ``interpret=True`` runs the
+   Mosaic interpreter on CPU, so these are real kernel-semantics tests,
+   not a shadow implementation.
+
+2. Engine layer — ``paged_attn_impl="kernel"`` is a READ-PATH SWAP, NOT A
+   NUMERICS CHANGE: byte-identical greedy streams vs the XLA gather
+   across the whole engine matrix (churn, chunked prefill, prefix hits,
+   mid-stream cancellation, spec K=4, pipeline 0/1). int8 KV is lossy by
+   design, so its contract is different: gather and kernel must agree
+   with each other EXACTLY (same dequant arithmetic), the token stream
+   must track the fp32 engine within a stated tolerance, the quant-error
+   gauge must be small but nonzero, and prefix export/import must refuse
+   to mix quantized and float payloads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from kubeflow_tpu.ops.paged_attention import (
+    dequantize_kv,
+    paged_attention,
+    quantize_kv,
+)
+from kubeflow_tpu.serve.engine import LMEngine
+from kubeflow_tpu.serve.server import (
+    decode_prefix_entries,
+    encode_prefix_entries,
+)
+
+CFG = TransformerConfig(
+    vocab_size=89, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+    causal=True, max_seq_len=256, attn_impl="reference", dtype=jnp.float32,
+    interpret_kernels=True,
+)
+EOS = 1
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return model, params
+
+
+def _prompts(rng, n, lo=3, hi=25, vocab=89):
+    return [
+        [int(x) for x in rng.integers(2, vocab, size=rng.integers(lo, hi))]
+        for _ in range(n)
+    ]
+
+
+# ------------------------------------------------------------ kernel unit
+
+
+def _oracle(q, k_pool, v_pool, table, pos0, P, window=None,
+            k_scale=None, v_scale=None):
+    """Straight-line numpy paged attention: gather the whole horizon
+    through the block table, mask, softmax in f64-free f32."""
+    B, H, S, D = q.shape
+    Hkv = k_pool.shape[0]
+    G = H // Hkv
+    W = table.shape[1] * P
+    j = np.arange(W)
+    out = np.zeros((B, H, S, D), np.float32)
+    kf = np.asarray(k_pool, np.float32)
+    vf = np.asarray(v_pool, np.float32)
+    if k_scale is not None:
+        kf = kf * np.asarray(k_scale)[:, :, None]
+        vf = vf * np.asarray(v_scale)[:, :, None]
+    for b in range(B):
+        flat = np.asarray(table)[b, j // P] * P + j % P
+        K = kf[:, flat, :]
+        V = vf[:, flat, :]
+        for h in range(H):
+            hk = h // G
+            for s in range(S):
+                qpos = pos0[b] + s
+                mask = j <= qpos
+                if window is not None:
+                    mask &= j > qpos - window
+                sc = (np.asarray(q[b, h, s], np.float32) @ K[hk].T)
+                sc = sc / np.sqrt(D)
+                sc = np.where(mask, sc, -1e30)
+                sc = sc - sc.max()
+                p = np.exp(sc)
+                p /= p.sum()
+                out[b, h, s] = p @ V[hk]
+    return out
+
+
+@pytest.mark.parametrize(
+    "name,kw",
+    [
+        ("decode", dict()),
+        ("gqa_span", dict(S=5)),
+        ("window", dict(S=3, window=24)),
+        ("mha", dict(H=2, Hkv=2)),
+        ("int8_span", dict(S=5, quant=True)),
+        ("int8_window", dict(S=2, window=20, quant=True)),
+    ],
+)
+def test_kernel_matches_oracle(name, kw):
+    rng = np.random.default_rng(hash(name) % 2**31)
+    B, H, Hkv, S, D, P = 2, kw.pop("H", 4), kw.pop("Hkv", 2), \
+        kw.pop("S", 1), 64, 16
+    window = kw.pop("window", None)
+    quant = kw.pop("quant", False)
+    n_pages, W_pages = 8, 4
+    T = n_pages * P
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    kp = rng.normal(size=(Hkv, T, D)).astype(np.float32)
+    vp = rng.normal(size=(Hkv, T, D)).astype(np.float32)
+    # random distinct non-scratch pages per row; row 1 ends mid-page so
+    # the partial-last-page mask is exercised every run
+    table = np.zeros((B, W_pages), np.int32)
+    perm = rng.permutation(np.arange(1, n_pages))
+    table[0] = perm[:W_pages]
+    table[1] = perm[:W_pages][::-1]
+    pos0 = np.array([W_pages * P - S, (W_pages - 1) * P - S], np.int32)
+    ks = vs = None
+    if quant:
+        kq, ks = quantize_kv(jnp.asarray(kp))
+        vq, vs = quantize_kv(jnp.asarray(vp))
+        kpo, vpo = kq, vq
+    else:
+        kpo, vpo = jnp.asarray(kp), jnp.asarray(vp)
+    out = paged_attention(
+        q, kpo, vpo, jnp.asarray(table), jnp.asarray(pos0),
+        page_size=P, window=window, k_scale=ks, v_scale=vs, interpret=True,
+    )
+    ref = _oracle(q, kpo, vpo, table, pos0, P, window=window,
+                  k_scale=ks, v_scale=vs)
+    assert np.max(np.abs(np.asarray(out) - ref)) < 2e-5
+
+
+def test_kernel_first_token_pos0_zero():
+    """pos0=0: exactly one unmasked key; later pages fully masked must
+    not poison the accumulator (the exp(0)=1 garbage-tile hazard)."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 2, 1, 32)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(1, 64, 32)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(1, 64, 32)), jnp.float32)
+    tb = np.array([[1, 0]], np.int32)
+    pos0 = np.array([0], np.int32)
+    out = paged_attention(
+        q, kp, vp, jnp.asarray(tb), jnp.asarray(pos0),
+        page_size=32, interpret=True,
+    )
+    ref = _oracle(q, kp, vp, tb, pos0, 32)
+    assert np.max(np.abs(np.asarray(out) - ref)) < 2e-5
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_quantize_roundtrip_bound():
+    """Per-token-per-head symmetric int8: roundtrip error is bounded by
+    half a quantization step of that token's own scale, and the scale
+    floor keeps all-zero tokens representable."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(2, 48, 64)) * 3.0, jnp.float32)
+    codes, scale = quantize_kv(x)
+    assert codes.dtype == jnp.int8 and scale.shape == (2, 48)
+    back = dequantize_kv(codes, scale)
+    step = np.asarray(scale)[:, :, None]
+    assert np.all(np.abs(np.asarray(back) - np.asarray(x)) <= step * 0.5 + 1e-7)
+    # zero token: scale floors, codes stay zero, roundtrip exact
+    z_codes, z_scale = quantize_kv(jnp.zeros((1, 4, 8), jnp.float32))
+    assert np.all(np.asarray(z_codes) == 0) and np.all(np.asarray(z_scale) > 0)
+
+
+# ------------------------------------------------- engine: kernel parity
+
+MAX_NEW = 12
+
+
+def _run_engine(model, params, prompts, *, max_new=MAX_NEW, **kw):
+    kw.setdefault("kv_pool_tokens", 16 * 24)
+    kw.setdefault("page_size", 16)
+    eng = LMEngine(
+        model, CFG, params, max_batch=4, max_seq=96, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS, **kw,
+    ).start()
+    try:
+        # concurrent submits → requests batch up to max_batch, so the
+        # parity matrix also exercises batched decode (streams are
+        # row-independent, so results don't depend on batch packing)
+        with ThreadPoolExecutor(len(prompts)) as ex:
+            futs = [
+                ex.submit(eng.submit, p, max_new_tokens=max_new)
+                for p in prompts
+            ]
+            return [f.result() for f in futs]
+    finally:
+        eng.stop()
+
+
+@pytest.fixture(scope="module")
+def shared_prompts():
+    return _prompts(np.random.default_rng(7), 5)
+
+
+@pytest.fixture(scope="module")
+def gather_streams(model_and_params, shared_prompts):
+    """The gather/fp32 baseline every parity test compares against —
+    computed once; both the kernel matrix and the int8 contract measure
+    relative to these streams."""
+    model, params = model_and_params
+    return _run_engine(model, params, shared_prompts)
+
+
+def test_kernel_byte_parity_matrix(model_and_params, shared_prompts,
+                                   gather_streams):
+    """The read-path swap across the engine matrix: plain decode,
+    pipeline_depth=0, and spec K=4 all emit byte-identical streams under
+    gather and kernel."""
+    model, params = model_and_params
+    for name, kw in [
+        ("kernel", dict(paged_attn_impl="kernel")),
+        ("kernel_pipe0", dict(paged_attn_impl="kernel", pipeline_depth=0)),
+        ("kernel_spec4", dict(paged_attn_impl="kernel", spec_draft_tokens=4)),
+    ]:
+        got = _run_engine(model, params, shared_prompts, **kw)
+        assert got == gather_streams, name
+
+
+def test_kernel_parity_churn_chunked_prefix_cancel(model_and_params):
+    """Gather vs kernel under the full serving shape at once: staggered
+    concurrent arrivals (admission churn), chunked prefill, prefix-cache
+    hits (same long prompt resubmitted), and a mid-stream cancellation
+    walking away after one chunk."""
+    model, params = model_and_params
+    rng = np.random.default_rng(23)
+    prompts = _prompts(rng, 3, lo=3, hi=14) + [
+        [int(x) for x in rng.integers(2, 89, size=n)] for n in (34, 41)
+    ]
+
+    def run(impl):
+        eng = LMEngine(
+            model, CFG, params, max_batch=3, max_seq=96, chunk_steps=4,
+            prefill_buckets=(48,), eos_id=EOS, prefill_chunk=16,
+            prefix_cache_entries=4, kv_pool_tokens=16 * 24, page_size=16,
+            paged_attn_impl=impl,
+        ).start()
+        outs: dict[int, list[int]] = {}
+        errors: list[Exception] = []
+
+        def worker(i):
+            try:
+                time.sleep(0.02 * i)
+                outs[i] = eng.submit(prompts[i], max_new_tokens=8)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            stream = eng.stream(prompts[0], max_new_tokens=12)
+            next(iter(stream))
+            stream.close()
+            for t in threads:
+                t.join(180)
+            # exact resubmit of the long prompt after its first run
+            # completed → a deterministic prefix-cache hit
+            outs["resub"] = eng.submit(prompts[-1], max_new_tokens=8)
+            stats = dict(eng.stats)
+        finally:
+            eng.stop()
+        assert not errors, errors
+        return outs, stats
+
+    want, want_stats = run("gather")
+    got, got_stats = run("kernel")
+    assert got == want
+    assert got_stats["max_concurrent"] >= 2  # churn really happened
+    assert got_stats["prefix_hits"] >= 1  # the resubmit hit the cache
+
+
+def test_kernel_requires_paged_cache(model_and_params):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="paged"):
+        LMEngine(
+            model, CFG, params, max_batch=2, max_seq=64, chunk_steps=4,
+            eos_id=EOS, paged_attn_impl="kernel",
+        )
+    with pytest.raises(ValueError, match="paged"):
+        LMEngine(
+            model, CFG, params, max_batch=2, max_seq=64, chunk_steps=4,
+            eos_id=EOS, kv_quant="int8",
+        )
+    with pytest.raises(ValueError, match="paged_attn_impl"):
+        LMEngine(
+            model, CFG, params, max_batch=2, max_seq=64, chunk_steps=4,
+            eos_id=EOS, kv_pool_tokens=16 * 8, page_size=16,
+            paged_attn_impl="nope",
+        )
+    with pytest.raises(ValueError, match="kv_quant"):
+        LMEngine(
+            model, CFG, params, max_batch=2, max_seq=64, chunk_steps=4,
+            eos_id=EOS, kv_pool_tokens=16 * 8, page_size=16, kv_quant="fp8",
+        )
+
+
+# ---------------------------------------------------- engine: int8 KV
+
+
+@pytest.fixture(scope="module")
+def int8_gather(model_and_params, shared_prompts):
+    """One int8 gather engine run shared by the parity and gauge tests:
+    (streams, kv_quant_error observed after serving the prompts)."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=4, max_seq=96, chunk_steps=4,
+        prefill_buckets=(32,), eos_id=EOS, kv_pool_tokens=16 * 24,
+        page_size=16, kv_quant="int8",
+    ).start()
+    try:
+        with ThreadPoolExecutor(len(shared_prompts)) as ex:
+            futs = [
+                ex.submit(eng.submit, p, max_new_tokens=MAX_NEW)
+                for p in shared_prompts
+            ]
+            outs = [f.result() for f in futs]
+        err = eng.overlap["kv_quant_error"]
+    finally:
+        eng.stop()
+    return outs, err
+
+
+def test_int8_gather_kernel_agree_and_track_fp32(model_and_params,
+                                                 shared_prompts,
+                                                 gather_streams,
+                                                 int8_gather):
+    """int8's two-sided contract: gather and kernel dequantize with the
+    SAME arithmetic (exact agreement), and the quantized stream tracks
+    the fp32 engine closely — on this tiny model (d_model=32, vocab 89,
+    near-tie logits) a handful of flips is expected, so the tolerance is
+    a match fraction, not equality. Spec decode on the same quantized
+    pool must not introduce further drift vs its own non-spec run."""
+    model, params = model_and_params
+    g8, _ = int8_gather
+    k8 = _run_engine(model, params, shared_prompts, kv_quant="int8",
+                     paged_attn_impl="kernel")
+    assert g8 == k8  # same dequant arithmetic → bitwise same streams
+    pairs = [
+        (a, b) for p, q in zip(gather_streams, g8) for a, b in zip(p, q)
+    ]
+    match = float(np.mean([a == b for a, b in pairs]))
+    assert match >= 0.85, match
+    s8 = _run_engine(model, params, shared_prompts, kv_quant="int8",
+                     paged_attn_impl="kernel", spec_draft_tokens=4)
+    assert s8 == k8  # verify-span reads the same quantized pool
+
+
+def test_int8_quant_error_gauge(int8_gather):
+    """The EWMA gauge is live (nonzero — quantization really is lossy)
+    and small (int8 per-token scales keep relative error well under 5%),
+    and it shows up in engine_stats for /metrics exposition."""
+    _, err = int8_gather
+    assert 0.0 < err < 0.05, err
+
+
+def test_prefix_transfer_rejects_mixed_quantization(model_and_params):
+    """Cross-replica prefix-KV transfer: a float engine must skip int8
+    payloads (it would attend to raw codes) and an int8 engine must skip
+    float payloads (no scales to dequantize with) — in both directions,
+    through the real wire encode/decode. Like-to-like int8 transfer
+    still works."""
+    model, params = model_and_params
+    rng = np.random.default_rng(13)
+    prompt = [int(x) for x in rng.integers(2, 89, size=40)]
+
+    def engine(quant):
+        return LMEngine(
+            model, CFG, params, max_batch=2, max_seq=96, chunk_steps=4,
+            prefill_buckets=(48,), eos_id=EOS, prefix_cache_entries=4,
+            kv_pool_tokens=16 * 24, page_size=16, kv_quant=quant,
+        ).start()
+
+    fp_eng, q_eng = engine("none"), engine("int8")
+    try:
+        fp_eng.submit(prompt, max_new_tokens=4)
+        q_eng.submit(prompt, max_new_tokens=4)
+        fp_entries = fp_eng.export_prefix_entries()
+        q_entries = q_eng.export_prefix_entries()
+        assert fp_entries and q_entries
+        # int8 entries carry scales on the wire; float entries don't
+        layer0 = next(iter(q_entries[0][1].values()))
+        assert set(layer0) == {"k", "v", "k_scale", "v_scale"}
+        assert layer0["k"].dtype == np.int8
+        # wire roundtrip preserves the key-set discriminator
+        fp_wire = decode_prefix_entries(encode_prefix_entries(fp_entries))
+        q_wire = decode_prefix_entries(encode_prefix_entries(q_entries))
+        assert fp_eng.import_prefix_entries(q_wire) == 0
+        assert q_eng.import_prefix_entries(fp_wire) == 0
+        # like-to-like works end to end
+        peer = engine("int8")
+        try:
+            assert peer.import_prefix_entries(q_wire) == len(q_wire)
+        finally:
+            peer.stop()
+    finally:
+        fp_eng.stop()
+        q_eng.stop()
+
+
+def test_int8_pool_bytes_quartered(model_and_params):
+    """The density claim, measured on the live cache: int8 k/v pools
+    bill 1 byte/elem vs f32's 4 (half of a bf16 pool), with the f32
+    per-token scale side arrays a ~1/D overhead on top."""
+    model, params = model_and_params
+
+    def pool_bytes(quant):
+        eng = LMEngine(
+            model, CFG, params, max_batch=2, max_seq=64, chunk_steps=4,
+            eos_id=EOS, kv_pool_tokens=16 * 8, page_size=16, kv_quant=quant,
+        )
+        kv = sum(
+            int(lc[w].nbytes) for lc in eng.cache.values() for w in ("k", "v")
+        )
+        sc = sum(
+            int(a.nbytes)
+            for lc in eng.cache.values()
+            for w, a in lc.items() if w.endswith("_scale")
+        )
+        return kv, sc
+
+    fp_kv, fp_sc = pool_bytes("none")
+    q_kv, q_sc = pool_bytes("int8")
+    assert fp_sc == 0
+    assert q_kv * 4 == fp_kv
+    head_dim = CFG.d_model // CFG.n_heads
+    assert q_sc == q_kv * 4 // head_dim  # one f32 scale per token per head
