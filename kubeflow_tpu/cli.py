@@ -40,6 +40,9 @@ Subcommands:
   the CI gate (exit 0 clean / 1 findings / 2 usage error).
 - ``kft doctor``       — accelerator liveness via the subprocess probe
   (never hangs on a wedged tunnel) + device inventory.
+- ``kft trace dump``   — fetch tail-sampled request traces from a serving
+  replica's ``/debug/traces``; ``--perfetto`` converts to Chrome/Perfetto
+  ``trace_event`` JSON loadable in ``ui.perfetto.dev``.
 - ``kft version``.
 
 Everything here is a thin veneer over public APIs — the CLI owns argument
@@ -884,6 +887,28 @@ def _cmd_doctor(args) -> int:
     return 0 if report["reachable"] else 1
 
 
+def _cmd_trace(args) -> int:
+    data = _api(
+        args.server, "GET", f"/debug/traces?limit={args.limit}",
+        prog="kft trace",
+    )
+    if args.perfetto:
+        from kubeflow_tpu.obs.trace import to_perfetto
+
+        data = to_perfetto(data)
+    text = json.dumps(data, indent=1, sort_keys=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        n = len(data.get("traceEvents", []) if args.perfetto
+                else data.get("traces", []))
+        print(f"wrote {args.output} ({n} "
+              f"{'events' if args.perfetto else 'traces'})")
+    else:
+        print(text)
+    return 0
+
+
 def _cmd_version(_args) -> int:
     import kubeflow_tpu
 
@@ -1056,6 +1081,26 @@ def main(argv: list[str] | None = None) -> int:
     d = sub.add_parser("doctor", help="accelerator liveness + inventory")
     d.add_argument("--timeout", type=float, default=120.0)
     d.set_defaults(fn=_cmd_doctor)
+
+    tr = sub.add_parser(
+        "trace", help="request-tracing verbs against a serving replica"
+    )
+    tr_sub = tr.add_subparsers(dest="action", required=True)
+    trd = tr_sub.add_parser(
+        "dump",
+        help="fetch tail-sampled traces from /debug/traces "
+             "(--perfetto → Chrome/Perfetto trace_event JSON)",
+    )
+    trd.add_argument("--server", required=True,
+                     help="replica base URL, e.g. http://127.0.0.1:8000")
+    trd.add_argument("--limit", type=int, default=64,
+                     help="max traces to fetch (newest first)")
+    trd.add_argument("--perfetto", action="store_true",
+                     help="emit Perfetto trace_event JSON instead of the "
+                          "raw snapshot")
+    trd.add_argument("-o", "--output", default=None,
+                     help="write to a file instead of stdout")
+    trd.set_defaults(fn=_cmd_trace)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=_cmd_version)

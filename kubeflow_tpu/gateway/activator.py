@@ -81,16 +81,30 @@ class Activator:
     def depth(self, service: str) -> int:
         return len(self._parked.get(service, ()))
 
-    async def wait(self, service: str, *, timeout_s: float | None = None) -> None:
-        """Park until ``notify(service)`` — admission order preserved."""
+    async def wait(
+        self,
+        service: str,
+        *,
+        timeout_s: float | None = None,
+        span=None,
+    ) -> None:
+        """Park until ``notify(service)`` — admission order preserved.
+
+        ``span`` (the gateway's ``activator.park`` span) records how deep
+        the request parked and whether the episode ended in activation or
+        a timeout."""
         q = self._parked.setdefault(service, deque())
         if len(q) >= self.queue_limit:
+            if span:
+                span.event("overflow", parked=len(q))
             raise QueueOverflow(
                 f"activator queue for {service!r} is full "
                 f"({self.queue_limit} parked)"
             )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         q.append(fut)
+        if span:
+            span.set_attr("parked_depth", len(q))
         QUEUE_DEPTH.labels(service=service).set(len(q))
         ACTIVATOR_QUEUE_DEPTH.labels(service=service).set(len(q))
         if service not in self._kicked and self.scale_up is not None:
@@ -105,7 +119,11 @@ class Activator:
             await asyncio.wait_for(
                 fut, self.timeout_s if timeout_s is None else timeout_s
             )
+            if span:
+                span.event("activated")
         except asyncio.TimeoutError:
+            if span:
+                span.event("timeout")
             raise ActivationTimeout(
                 f"no backend for {service!r} became ready in time"
             ) from None

@@ -104,6 +104,16 @@ class ServiceRoute:
             "hedge_ms": self.hedge_ms,
         }
 
+    def trace_attrs(self) -> dict:
+        """Span attributes for the edge ``route`` span — only the routing
+        policy that shaped THIS decision, not the whole view."""
+        attrs: dict = {"service": self.name, "affinity": self.affinity}
+        if self.canary_percent:
+            attrs["canary_percent"] = self.canary_percent
+        if self.hedge_ms is not None:
+            attrs["hedge_ms"] = self.hedge_ms
+        return attrs
+
 
 _MODEL_PATH = re.compile(r"^/v[12]/models/([^/:]+)")
 _GENERATE_PATH = re.compile(r"^/v2/models/[^/:]+/(generate|generate_stream)$")

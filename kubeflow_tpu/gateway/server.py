@@ -59,6 +59,8 @@ from kubeflow_tpu.gateway.router import (
     ServiceRoute,
     affinity_key_of,
 )
+from kubeflow_tpu.obs.headers import TENANT_HEADER, TRACE_HEADER
+from kubeflow_tpu.obs.trace import TRACER, ctx_from_headers
 from kubeflow_tpu.serve.deadline import (
     DEADLINE_ABS_HEADER,
     DEADLINE_HEADER,
@@ -108,6 +110,19 @@ _IDEMPOTENT_SUFFIXES = (":predict", "/infer")
 
 #: upstream statuses that indicate backend (not request) trouble
 _BACKEND_FAILURE_STATUSES = (502, 503, 504)
+
+
+def _edge_status(status: int, headers=None) -> str:
+    """Span status for an edge response: coherent sheds (429, 503 with
+    Retry-After) end the trace as ``shed`` — tail-sampled like errors —
+    while other 5xx are ``error``."""
+    if status == 429 or (
+        status == 503 and headers is not None and "Retry-After" in headers
+    ):
+        return "shed"
+    if status >= 500:
+        return "error"
+    return "ok"
 
 
 class _UpstreamError(Exception):
@@ -364,31 +379,57 @@ class InferenceGateway:
             )
         route, path = resolved
         service = route.name
-        tenant = request.headers.get("x-kft-tenant", "default")
+        # root span for the whole edge decision: continues the client's
+        # trace when a valid x-kft-trace rides in, mints one otherwise.
+        # Every downstream hop (proxy attempt, dataplane, engine) parents
+        # onto this id — ONE trace from edge to decode chunk.
+        span = TRACER.span("route", ctx=ctx_from_headers(request.headers))
+        if span:
+            for k, v in route.trace_attrs().items():
+                span.set_attr(k, v)
+            span.set_attr("path", path)
+            span.set_attr("method", request.method)
+        tenant = request.headers.get(TENANT_HEADER, "default")
         try:
             self.policy.acquire(tenant)
         except RateLimited as e:
             SHED.labels(service=service, reason="rate_limit").inc()
             REQUESTS.labels(service=service, code="429").inc()
+            if span:
+                span.event("rate_limited", tenant=tenant)
+                span.end("shed")
             raise web.HTTPTooManyRequests(
                 reason=str(e), headers={"Retry-After": "1"}
             )
         except TooManyInFlight as e:
             SHED.labels(service=service, reason="inflight_cap").inc()
             REQUESTS.labels(service=service, code="429").inc()
+            if span:
+                span.event("inflight_cap", tenant=tenant)
+                span.end("shed")
             raise web.HTTPTooManyRequests(reason=str(e))
         try:
-            resp = await self._routed(request, route, path)
+            resp = await self._routed(request, route, path, span)
             REQUESTS.labels(service=service, code=str(resp.status)).inc()
+            if span:
+                span.set_attr("status", resp.status)
+                span.end(_edge_status(resp.status, resp.headers))
             return resp
         except web.HTTPException as e:
             REQUESTS.labels(service=service, code=str(e.status)).inc()
+            if span:
+                span.set_attr("status", e.status)
+                span.end(_edge_status(e.status, e.headers))
+            raise
+        except BaseException:
+            if span:
+                span.end("error")
             raise
         finally:
             self.policy.release(tenant)
             LATENCY.labels(service=service).observe(time.perf_counter() - t0)
 
-    async def _routed(self, request, route: ServiceRoute, path: str):
+    async def _routed(self, request, route: ServiceRoute, path: str, span=None):
         from aiohttp import web
 
         req_id = request.headers.get("x-request-id") or uuid.uuid4().hex
@@ -412,6 +453,13 @@ class InferenceGateway:
         # never forward it, backends re-anchor from the ms budget
         fwd.pop(DEADLINE_ABS_HEADER, None)
         fwd.pop(DEADLINE_ABS_HEADER.title(), None)
+        # never forward the client's raw trace header: each upstream
+        # attempt stamps ITS OWN span id (see _attempt_once), so backend
+        # spans parent onto the attempt that actually carried them
+        fwd.pop(TRACE_HEADER, None)
+        fwd.pop(TRACE_HEADER.title(), None)
+        if span:
+            fwd[TRACE_HEADER] = span.header()
         #: the end-to-end budget, anchored at edge arrival: queue time in
         #: the activator and retry rounds are charged against it. Only
         #: the WIRE header counts — an absolute stamp arriving off the
@@ -424,7 +472,7 @@ class InferenceGateway:
         )
         # managed tenants get their policy priority stamped (gateway-
         # authoritative — a client cannot self-promote its shed order)
-        tenant = request.headers.get("x-kft-tenant", "default")
+        tenant = request.headers.get(TENANT_HEADER, "default")
         prio = self.policy.priority_of(tenant)
         if prio is not None:
             fwd.pop(PRIORITY_HEADER.title(), None)
@@ -451,6 +499,8 @@ class InferenceGateway:
                     # edge with the shed marker (503 + Retry-After), and
                     # never as a retryable backend failure
                     SHED.labels(service=route.name, reason="deadline").inc()
+                    if span:
+                        span.event("deadline_expired", stage="edge")
                     raise web.HTTPServiceUnavailable(
                         reason="request deadline expired at the gateway",
                         headers={"Retry-After": "1"},
@@ -467,14 +517,27 @@ class InferenceGateway:
                 parks += 1
                 if parks > 8:
                     break  # repeated wake-ups without capacity: shed below
+                # cold-start parking is often the dominant edge latency —
+                # it gets its own span so traces show WHERE the time went
+                pspan = (
+                    TRACER.span("activator.park", parent=span)
+                    if span
+                    else None
+                )
                 try:
-                    await self.activator.wait(route.name)
+                    await self.activator.wait(route.name, span=pspan)
+                    if pspan:
+                        pspan.end()
                 except QueueOverflow as e:
+                    if pspan:
+                        pspan.end("shed")
                     SHED.labels(
                         service=route.name, reason="queue_full"
                     ).inc()
                     raise web.HTTPTooManyRequests(reason=str(e))
                 except ActivationTimeout as e:
+                    if pspan:
+                        pspan.end("shed")
                     SHED.labels(
                         service=route.name, reason="activation_timeout"
                     ).inc()
@@ -486,11 +549,12 @@ class InferenceGateway:
                     # attempt (no response bytes have committed yet);
                     # mid-stream failures are terminal inside _proxy_stream
                     return await self._proxy_stream(
-                        request, route, backend, path, fwd, body
+                        request, route, backend, path, fwd, body,
+                        parent=span,
                     )
                 return await self._attempt(
                     route, backend, request.method, path, fwd, body,
-                    idempotent=idempotent, timeout_s=remaining,
+                    idempotent=idempotent, timeout_s=remaining, parent=span,
                 )
             except _UpstreamError as e:
                 last_err = e
@@ -503,6 +567,10 @@ class InferenceGateway:
                     and budget.try_spend()
                 ):
                     RETRIES.labels(service=route.name).inc()
+                    if span:
+                        span.event(
+                            "retry", attempt=attempts, backend=e.backend.url
+                        )
                     continue
                 break
         SHED.labels(service=route.name, reason="no_backend").inc()
@@ -570,6 +638,7 @@ class InferenceGateway:
         *,
         idempotent: bool,
         timeout_s: float | None = None,
+        parent=None,
     ):
         if (
             route.hedge_ms is not None
@@ -577,20 +646,24 @@ class InferenceGateway:
             and len(self.pool.selectable(route.name)) > 1
         ):
             return await self._hedged(
-                route, backend, method, path, fwd, body, timeout_s
+                route, backend, method, path, fwd, body, timeout_s,
+                parent=parent,
             )
         return await self._attempt_once(
-            route, backend, method, path, fwd, body, timeout_s
+            route, backend, method, path, fwd, body, timeout_s,
+            parent=parent,
         )
 
     async def _hedged(
-        self, route, primary, method, path, fwd, body, timeout_s=None
+        self, route, primary, method, path, fwd, body, timeout_s=None,
+        *, parent=None,
     ):
         """Race a second attempt dispatched ``hedge_ms`` after the first;
         first success wins, the loser is cancelled."""
         first = asyncio.ensure_future(
             self._attempt_once(
-                route, primary, method, path, fwd, body, timeout_s
+                route, primary, method, path, fwd, body, timeout_s,
+                parent=parent, racing=True,
             )
         )
         done, _ = await asyncio.wait(
@@ -604,7 +677,8 @@ class InferenceGateway:
         HEDGES.labels(service=route.name).inc()
         second = asyncio.ensure_future(
             self._attempt_once(
-                route, second_backend, method, path, fwd, body, timeout_s
+                route, second_backend, method, path, fwd, body, timeout_s,
+                parent=parent, hedged=True, racing=True,
             )
         )
         pending = {first, second}
@@ -622,17 +696,32 @@ class InferenceGateway:
             if result is not None:
                 for t in pending:
                     t.cancel()
+                # drain the loser so its span closes (as "cancelled")
+                # before the trace's root span can finalize
+                await asyncio.gather(*pending, return_exceptions=True)
                 return result
         assert err is not None
         raise err
 
     async def _attempt_once(
         self, route, backend: Backend, method, path, fwd, body,
-        timeout_s: float | None = None,
+        timeout_s: float | None = None, *, parent=None, hedged: bool = False,
+        racing: bool = False,
     ):
         import aiohttp
         from aiohttp import web
 
+        span = TRACER.span("proxy", parent=parent) if parent else None
+        if span:
+            span.set_attr("backend", backend.url)
+            span.set_attr("revision", backend.revision)
+            if hedged:
+                span.set_attr("hedge", True)
+            # copy before stamping: hedged/retried attempts share fwd, and
+            # each must carry ITS OWN span id so the backend's spans parent
+            # onto the attempt that actually reached it
+            fwd = dict(fwd)
+            fwd[TRACE_HEADER] = span.header()
         total = self.config.upstream_timeout_s
         if timeout_s is not None:
             # a deadline-bearing request never waits on a backend longer
@@ -658,7 +747,18 @@ class InferenceGateway:
                 retry_after = upstream.headers.get("Retry-After")
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
             self.pool.record(backend, ok=False)
+            if span:
+                span.set_attr("error", str(e) or type(e).__name__)
+                span.end("error")
             raise _UpstreamError(backend, e) from e
+        except asyncio.CancelledError:
+            # the hedge loser lands here mid-flight: its span must still
+            # close, or the trace never finalizes for export
+            if span:
+                if racing:
+                    span.set_attr("hedge_loser", True)
+                span.end("cancelled")
+            raise
         finally:
             self.pool.release(backend)
         if status == 503 and retry_after is not None:
@@ -669,16 +769,25 @@ class InferenceGateway:
             # penalty (the replica answered rationally).
             self.pool.record(backend, ok=True)
             SHED.labels(service=route.name, reason="upstream_shed").inc()
+            if span:
+                span.set_attr("status", status)
+                span.end("shed")
             return web.Response(
                 body=payload, status=status,
                 headers={"Content-Type": ctype, "Retry-After": retry_after},
             )
         if status in _BACKEND_FAILURE_STATUSES:
             self.pool.record(backend, ok=False)
+            if span:
+                span.set_attr("status", status)
+                span.end("error")
             raise _UpstreamError(
                 backend, RuntimeError(f"upstream returned {status}")
             )
         self.pool.record(backend, ok=True)
+        if span:
+            span.set_attr("status", status)
+            span.end()
         return web.Response(
             body=payload, status=status, headers={"Content-Type": ctype}
         )
@@ -686,7 +795,8 @@ class InferenceGateway:
     # -- SSE passthrough ------------------------------------------------- #
 
     async def _proxy_stream(
-        self, request, route: ServiceRoute, backend: Backend, path, fwd, body
+        self, request, route: ServiceRoute, backend: Backend, path, fwd,
+        body, *, parent=None,
     ):
         """Stream upstream SSE bytes to the client verbatim. A backend
         that dies mid-stream yields one clean terminal error frame; a
@@ -695,6 +805,13 @@ class InferenceGateway:
         import aiohttp
         from aiohttp import web
 
+        span = TRACER.span("proxy", parent=parent) if parent else None
+        if span:
+            span.set_attr("backend", backend.url)
+            span.set_attr("revision", backend.revision)
+            span.set_attr("stream", True)
+            fwd = dict(fwd)
+            fwd[TRACE_HEADER] = span.header()
         self.pool.acquire(backend)
         upstream = None
         try:
@@ -710,6 +827,9 @@ class InferenceGateway:
                 )
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
                 self.pool.record(backend, ok=False)
+                if span:
+                    span.set_attr("error", str(e) or type(e).__name__)
+                    span.end("error")
                 raise _UpstreamError(backend, e) from e
             if upstream.status != 200:
                 # pre-stream refusal (429 overload, 400, 501, deadline
@@ -736,6 +856,17 @@ class InferenceGateway:
                 }
                 if "Retry-After" in upstream.headers:
                     hdrs["Retry-After"] = upstream.headers["Retry-After"]
+                if span:
+                    span.set_attr("status", upstream.status)
+                    span.end(
+                        "shed"
+                        if shed_503
+                        else (
+                            "error"
+                            if upstream.status in _BACKEND_FAILURE_STATUSES
+                            else "ok"
+                        )
+                    )
                 return web.Response(
                     body=payload, status=upstream.status, headers=hdrs
                 )
@@ -754,16 +885,25 @@ class InferenceGateway:
                 # backend died mid-stream: a clean terminal frame, not a
                 # torn socket — the client's SSE parser sees one error event
                 self.pool.record(backend, ok=False)
+                if span:
+                    span.event("mid_stream_failure", error=str(e) or type(e).__name__)
+                    span.end("error")
                 frame = json.dumps(
                     {"error": f"upstream failed mid-stream: {e}"}
                 )
                 await resp.write(f"data: {frame}\n\n".encode())
             await resp.write_eof()
+            if span:
+                span.end()
             return resp
         finally:
             if upstream is not None:
                 upstream.close()  # hard close → backend sees the disconnect
             self.pool.release(backend)
+            if span is not None and span.end_time is None:
+                # a client disconnect raised out of resp.write above:
+                # close the span instead of leaking the trace open
+                span.end("cancelled")
 
     # -- runtime --------------------------------------------------------- #
 

@@ -1,0 +1,50 @@
+"""The single definition site for the ``x-kft-*`` wire-header contract.
+
+Every hop of the serving path (gateway → activator → dataplane → batcher →
+engine) reads or stamps these headers; before this module existed the
+deadline/priority names lived in ``serve/deadline.py`` while the tenant
+header was a bare literal at two gateway call sites — a rename was a grep,
+not a diff. All readers and stampers now import from here (``serve/deadline``
+re-exports the deadline/priority trio for back-compat).
+
+Semantics (the full contracts live with their consumers):
+
+- ``x-kft-deadline-ms`` — remaining end-to-end budget in milliseconds,
+  client- or gateway-set, REWRITTEN by the gateway at each dispatch
+  (serve/deadline.py).
+- ``x-kft-deadline-abs`` — process-local absolute ``time.monotonic()``
+  deadline stamped once at DataPlane admission. Never crosses a process:
+  the gateway strips it off the wire in both directions.
+- ``x-kft-priority`` — integer tenant priority (higher = shed last),
+  gateway-authoritative for managed tenants.
+- ``x-kft-tenant`` — tenant identity for rate limiting / priority lookup.
+- ``x-kft-trace`` — W3C ``traceparent``-shaped trace context
+  (``00-<trace32hex>-<span16hex>-<flags2hex>``), minted at the gateway or
+  accepted from the client, re-stamped with a child span id at every hop
+  (obs/trace.py).
+
+Header maps on the read side may be aiohttp ``CIMultiDict`` or plain
+``dict``; readers probe the exact lowercase name and its ``.title()``
+spelling rather than lowercasing a copy per request (deadline.py idiom).
+"""
+
+from __future__ import annotations
+
+#: wire header: remaining budget in milliseconds (client/gateway-set)
+DEADLINE_HEADER = "x-kft-deadline-ms"
+#: process-local absolute time.monotonic() deadline (DataPlane-stamped)
+DEADLINE_ABS_HEADER = "x-kft-deadline-abs"
+#: integer tenant priority, higher = shed last (gateway-stamped)
+PRIORITY_HEADER = "x-kft-priority"
+#: tenant identity for policy lookup (rate limit, in-flight cap, priority)
+TENANT_HEADER = "x-kft-tenant"
+#: W3C traceparent-shaped trace context (obs/trace.py mints and parses)
+TRACE_HEADER = "x-kft-trace"
+
+__all__ = [
+    "DEADLINE_HEADER",
+    "DEADLINE_ABS_HEADER",
+    "PRIORITY_HEADER",
+    "TENANT_HEADER",
+    "TRACE_HEADER",
+]

@@ -17,6 +17,7 @@ import sys
 import time
 import traceback
 
+from kubeflow_tpu.obs import trace
 from kubeflow_tpu.orchestrator import envwire
 
 
@@ -53,6 +54,9 @@ class JsonFormatter(logging.Formatter):
             "msg": record.getMessage(),
             **self.static_fields,
         }
+        ids = trace.current_ids()
+        if ids is not None:
+            entry["trace_id"], entry["span_id"] = ids
         if record.exc_info and record.exc_info[0] is not None:
             entry["exc"] = "".join(
                 traceback.format_exception(*record.exc_info)

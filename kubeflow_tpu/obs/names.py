@@ -183,3 +183,15 @@ ENGINE_ADMISSION_SHED_TOTAL = "kft_engine_admission_shed_total"
 ENGINE_WATCHDOG_TRIPS_TOTAL = "kft_engine_watchdog_trips_total"
 #: counter{model} — supervised engine restarts (device state rebuilt)
 ENGINE_RESTARTS_TOTAL = "kft_engine_restarts_total"
+
+# -- request tracing (obs/trace.py) -------------------------------------- #
+
+#: histogram{model} — server-side time-to-first-token of traced requests,
+#: milliseconds (engine enqueue → first pushed token)
+SERVER_TTFT_MS = "kft_server_ttft_ms"
+#: histogram{model} — server-side mean time-per-output-token after the
+#: first, milliseconds (the steady-state decode pace SLOs bind to)
+SERVER_TPOT_MS = "kft_server_tpot_ms"
+#: counter{decision} — tail-sampler verdicts on finished traces
+#: (error / slow / sampled / dropped); error+slow+sampled are retained
+TRACE_SAMPLER_DECISIONS_TOTAL = "kft_trace_sampler_decisions_total"

@@ -28,6 +28,7 @@ from pathlib import Path
 from typing import Any, Callable
 
 from kubeflow_tpu.obs import prom
+from kubeflow_tpu.obs.trace import TRACER, ctx_from_headers
 from kubeflow_tpu.obs.webhost import ThreadedAiohttpServer
 
 logger = logging.getLogger(__name__)
@@ -104,6 +105,14 @@ class ObsServer(ThreadedAiohttpServer):
             return web.json_response(
                 {"error": "a profile capture is already running"}, status=409
             )
+        # the capture itself becomes a span: traces answer "who triggered
+        # an XLA profile, when, and where did the dump land"
+        span = TRACER.span(
+            "profile.capture", ctx=ctx_from_headers(request.headers)
+        )
+        if span:
+            span.set_attr("logdir", str(logdir))
+            span.set_attr("seconds", seconds)
 
         def run():
             try:
@@ -115,7 +124,12 @@ class ObsServer(ThreadedAiohttpServer):
         # Trace on an executor thread: the capture brackets whatever the
         # process's compute threads do during the window, without blocking
         # the event loop.
-        await asyncio.get_running_loop().run_in_executor(None, run)
+        try:
+            await asyncio.get_running_loop().run_in_executor(None, run)
+        except Exception:
+            span.end("error")
+            raise
+        span.end()
         return web.json_response(
             {"logdir": str(logdir), "seconds": seconds}
         )

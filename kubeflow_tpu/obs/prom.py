@@ -24,6 +24,14 @@ _DEFAULT_BUCKETS = (
     2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+#: millisecond-scale buckets for latency histograms recorded in ms
+#: (TTFT/TPOT): the seconds-scale defaults would collapse every
+#: observation into the +Inf bucket
+MS_BUCKETS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+)
+
 
 def _label_key(labels: Mapping[str, str]) -> tuple[tuple[str, str], ...]:
     return tuple(sorted(labels.items()))

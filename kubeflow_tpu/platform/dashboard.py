@@ -233,6 +233,16 @@ class DashboardServer(ThreadedAiohttpServer):
         when no autoscaler is attached."""
         return {} if self.autoscaler is None else self.autoscaler.view()
 
+    def traces_view(self) -> dict:
+        """Tail-sampled traces from THIS process's tracer (obs/trace.py).
+        A dashboard colocated with the gateway/serving plane shows the
+        full edge→engine span trees; a standalone dashboard shows only
+        its own spans — cross-process aggregation stays on the operator
+        (``kft trace dump`` against each replica)."""
+        from kubeflow_tpu.obs.trace import TRACER
+
+        return TRACER.snapshot()
+
     def pipelines_view(self) -> list[dict]:
         return [] if self.lineage is None else self.lineage.runs()
 
@@ -467,6 +477,7 @@ class DashboardServer(ThreadedAiohttpServer):
         app.router.add_get("/api/queues", handler(self.queues_view))
         app.router.add_get("/api/gateway", handler(self.gateway_view))
         app.router.add_get("/api/autoscaler", handler(self.autoscaler_view))
+        app.router.add_get("/api/traces", handler(self.traces_view))
         app.router.add_get("/api/profiles", handler(self.profiles_view))
         app.router.add_get("/api/notebooks", handler(self.notebooks_view))
         app.router.add_get("/api/tensorboards", handler(self.tensorboards_view))
@@ -549,7 +560,7 @@ _INDEX_HTML = """<!doctype html>
 <header><h1>kubeflow-tpu</h1><nav id="nav"></nav></header>
 <main id="main"></main>
 <script>
-const tabs=["summary","jobs","queues","gateway","experiments","pipelines","models","notebooks","volumes","tensorboards","profiles"];
+const tabs=["summary","jobs","queues","gateway","traces","experiments","pipelines","models","notebooks","volumes","tensorboards","profiles"];
 let tab="summary";
 const $=(h)=>{const d=document.createElement("div");d.innerHTML=h;return d};
 const esc=(s)=>String(s).replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
@@ -604,6 +615,16 @@ async function render(){nav();const m=document.getElementById("main");m.textCont
   m.innerHTML=`<div class="bar"><i>edge routes, backend fitness, activator queues</i></div>`+
    `<h3>services</h3>`+table(svc,["name","canary","affinity","ready","queued","hosts"])+
    `<h3>backends</h3>`+table(bes,["service","url","revision","state","probe","breaker","outstanding"])}}
+ if(tab==="traces"){const t=await j("/api/traces");window._traces=t.traces||[];
+  const rows=window._traces.map(tr=>{const root=(tr.spans||[]).find(s=>!s.parent_span_id)||tr.spans[0]||{};
+   return {trace_id:raw(`<a href="#" onclick="spans('${uenc(tr.trace_id)}');return false"><code>${esc(tr.trace_id.slice(0,16))}…</code></a>`),
+    root:root.name||"—",kept:pill(tr.kept||"—"),spans:(tr.spans||[]).length,
+    ms:tr.duration_ms==null?"—":tr.duration_ms.toFixed(1)}});
+  m.innerHTML=`<div class="cards"><div class="card"><b>${t.finished??0}</b>finished</div>
+   <div class="card"><b>${t.live??0}</b>live</div>
+   <div class="card"><b>${t.p99_ms==null?"—":t.p99_ms.toFixed(1)}</b>p99 ms</div></div>
+   <div class="bar"><i>tail-sampled: errors/sheds kept 100%, plus ≥p99-slow and 1-in-16 samples</i></div>`+
+   table(rows,["trace_id","root","kept","spans","ms"])+`<pre id="detail" hidden></pre>`}
  if(tab==="experiments"){const rows=(await j("/api/experiments")).map(r=>({...r,
    name:raw(`<a href="#" onclick="trials('${uenc(r.name)}');return false">${esc(r.name)}</a>`)}));
   m.innerHTML=table(rows,["name","trials","succeeded","failed","running"])+`<pre id="detail" hidden></pre>`}
@@ -643,6 +664,9 @@ async function trials(name){const p=document.getElementById("detail");p.hidden=f
  p.textContent=JSON.stringify(await j(`/api/experiments/${name}/trials`),null,1)}
 async function versions(name){const p=document.getElementById("detail");p.hidden=false;
  p.textContent=JSON.stringify(await j(`/api/models/${name}/versions`),null,1)}
+function spans(tid){const p=document.getElementById("detail");p.hidden=false;
+ const tr=(window._traces||[]).find(t=>encodeURIComponent(t.trace_id)===tid||t.trace_id===decodeURIComponent(tid));
+ p.textContent=tr?JSON.stringify(tr,null,1):"trace gone"}
 async function tasks(run){const p=document.getElementById("detail");p.hidden=false;
  const g=document.getElementById("dag");
  try{const dag=await j(`/api/pipelines/${run}/dag`);

@@ -17,7 +17,11 @@ import dataclasses
 import time
 from typing import Any, Awaitable, Callable, Sequence
 
+from kubeflow_tpu.obs.trace import TRACER
 from kubeflow_tpu.serve.deadline import DEADLINE_EXPIRED, DeadlineExceeded
+
+#: queue entry: (instances, caller future, absolute deadline, wait span)
+_Entry = tuple[list[Any], asyncio.Future, "float | None", Any]
 
 
 @dataclasses.dataclass
@@ -43,7 +47,7 @@ class Batcher:
     ):
         self._handler = handler
         self.config = config or BatcherConfig()
-        self._queue: list[tuple[list[Any], asyncio.Future, float | None]] = []
+        self._queue: list[_Entry] = []
         self._flush_task: asyncio.Task | None = None
         self._lock = asyncio.Lock()
         self.stats = {
@@ -55,7 +59,7 @@ class Batcher:
     def queue_depth(self) -> int:
         """Instances waiting for the next flush — the balancer's backlog
         signal, exported as ``kft_server_queue_depth`` on /metrics."""
-        return sum(len(i) for i, _, _ in self._queue)
+        return sum(len(i) for i, _, _, _ in self._queue)
 
     @property
     def mean_occupancy(self) -> float:
@@ -67,17 +71,26 @@ class Batcher:
         return self.stats["instances"] / batches if batches else 0.0
 
     async def submit(
-        self, instances: list[Any], *, deadline: float | None = None
+        self,
+        instances: list[Any],
+        *,
+        deadline: float | None = None,
+        trace: Any = None,
     ) -> list[Any]:
         """``deadline`` (absolute ``time.monotonic()``) rides the queue
         entry: an entry whose deadline passes before its flush is shed
-        with :class:`DeadlineExceeded` instead of costing a forward."""
+        with :class:`DeadlineExceeded` instead of costing a forward.
+        ``trace`` (the caller's dataplane span) parents a ``batcher.wait``
+        span covering the entry's time in the queue."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        batch: list[tuple[list[Any], asyncio.Future, float | None]] | None
+        wspan = TRACER.span("batcher.wait", parent=trace) if trace else None
+        if wspan:
+            wspan.set_attr("instances", len(instances))
+        batch: list[_Entry] | None
         batch = None
         async with self._lock:
-            self._queue.append((instances, fut, deadline))
-            queued = sum(len(i) for i, _, _ in self._queue)
+            self._queue.append((instances, fut, deadline, wspan))
+            queued = sum(len(i) for i, _, _, _ in self._queue)
             if queued >= self.config.max_batch_size:
                 batch = self._pop_locked()
             elif self._flush_task is None:
@@ -94,24 +107,25 @@ class Batcher:
         if batch:
             await self._run_batch(batch)
 
-    def _pop_locked(self) -> list[tuple[list[Any], asyncio.Future, float | None]]:
+    def _pop_locked(self) -> list[_Entry]:
         if self._flush_task is not None and self._flush_task is not asyncio.current_task():
             self._flush_task.cancel()
             self._flush_task = None
         queue, self._queue = self._queue, []
         return queue
 
-    def _shed_expired(
-        self, queue: list[tuple[list[Any], asyncio.Future, float | None]]
-    ) -> list[tuple[list[Any], asyncio.Future, float | None]]:
+    def _shed_expired(self, queue: list[_Entry]) -> list[_Entry]:
         """Fail queued entries whose deadline passed while they waited for
         the flush — they must never consume a forward's batch slot."""
         now = time.monotonic()
         kept = []
-        for instances, fut, deadline in queue:
+        for instances, fut, deadline, wspan in queue:
             if deadline is not None and now > deadline and not fut.done():
                 self.stats["deadline_shed"] += 1
                 DEADLINE_EXPIRED.labels(stage="batch_queue").inc()
+                if wspan:
+                    wspan.event("deadline_expired", stage="batch_queue")
+                    wspan.end("deadline")
                 fut.set_exception(
                     DeadlineExceeded(
                         "deadline expired in the batch queue",
@@ -119,51 +133,68 @@ class Batcher:
                     )
                 )
             else:
-                kept.append((instances, fut, deadline))
+                kept.append((instances, fut, deadline, wspan))
         return kept
 
-    async def _run_batch(
-        self, queue: list[tuple[list[Any], asyncio.Future, float | None]]
-    ) -> None:
+    async def _run_batch(self, queue: list[_Entry]) -> None:
         queue = self._shed_expired(queue)
         if not queue:
             return
         flat: list[Any] = []
-        for instances, _, _ in queue:
+        for instances, _, _, _ in queue:
             flat.extend(instances)
+        # one flush span per batch, parented to the first traced caller;
+        # every caller's wait span ends here with the flush size it joined
+        fspan = None
+        for _, _, _, wspan in queue:
+            if wspan:
+                if fspan is None:
+                    fspan = TRACER.span("batcher.flush", parent=wspan)
+                    fspan.set_attr("flush_size", len(flat))
+                    fspan.set_attr("callers", len(queue))
+                wspan.set_attr("flush_size", len(flat))
+                wspan.end()
         try:
-            outputs: list[Any] = []
-            step = self.config.max_batch_size
-            for i in range(0, len(flat), step):
-                outputs.extend(await self._handler(flat[i : i + step]))
-                self.stats["batches"] += 1
-        except Exception as e:
-            if len(queue) == 1:
-                _, fut, _ = queue[0]
-                if not fut.done():
-                    fut.set_exception(e)
-                return
-            # Isolate the offender: re-run each caller's instances alone so
-            # one malformed request doesn't fail every co-batched one.
-            # Succeeded re-runs still count toward "instances" — skipping
-            # them silently deflated mean_occupancy after any co-batched
-            # failure — and the isolation event itself is counted so
-            # operators can see offender-isolation churn on /metrics.
-            self.stats["fail_isolations"] += 1
-            for instances, fut, _ in queue:
-                if fut.done():
-                    continue
-                try:
-                    fut.set_result(list(await self._handler(list(instances))))
+            try:
+                outputs: list[Any] = []
+                step = self.config.max_batch_size
+                for i in range(0, len(flat), step):
+                    outputs.extend(await self._handler(flat[i : i + step]))
                     self.stats["batches"] += 1
-                    self.stats["instances"] += len(instances)
-                except Exception as per:
-                    fut.set_exception(per)
-            return
-        self.stats["instances"] += len(flat)
-        off = 0
-        for instances, fut, _ in queue:
-            n = len(instances)
-            if not fut.done():
-                fut.set_result(outputs[off : off + n])
-            off += n
+            except Exception as e:
+                if len(queue) == 1:
+                    _, fut, _, _ = queue[0]
+                    if not fut.done():
+                        fut.set_exception(e)
+                    if fspan:
+                        fspan.end("error")
+                    return
+                # Isolate the offender: re-run each caller's instances alone so
+                # one malformed request doesn't fail every co-batched one.
+                # Succeeded re-runs still count toward "instances" — skipping
+                # them silently deflated mean_occupancy after any co-batched
+                # failure — and the isolation event itself is counted so
+                # operators can see offender-isolation churn on /metrics.
+                self.stats["fail_isolations"] += 1
+                if fspan:
+                    fspan.event("fail_isolation", callers=len(queue))
+                for instances, fut, _, _ in queue:
+                    if fut.done():
+                        continue
+                    try:
+                        fut.set_result(list(await self._handler(list(instances))))
+                        self.stats["batches"] += 1
+                        self.stats["instances"] += len(instances)
+                    except Exception as per:
+                        fut.set_exception(per)
+                return
+            self.stats["instances"] += len(flat)
+            off = 0
+            for instances, fut, _, _ in queue:
+                n = len(instances)
+                if not fut.done():
+                    fut.set_result(outputs[off : off + n])
+                off += n
+        finally:
+            if fspan:
+                fspan.end()

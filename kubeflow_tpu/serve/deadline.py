@@ -42,12 +42,14 @@ from typing import Callable, Mapping
 
 from kubeflow_tpu.obs import names, prom
 
-#: wire header: remaining budget in milliseconds (client/gateway-set)
-DEADLINE_HEADER = "x-kft-deadline-ms"
-#: process-local absolute time.monotonic() deadline (DataPlane-stamped)
-DEADLINE_ABS_HEADER = "x-kft-deadline-abs"
-#: integer tenant priority, higher = shed last (gateway-stamped)
-PRIORITY_HEADER = "x-kft-priority"
+# Header names are defined once in obs/headers.py (the whole x-kft-*
+# contract, including tenant + trace); re-exported here for the existing
+# importers of this module.
+from kubeflow_tpu.obs.headers import (  # noqa: F401 — re-export
+    DEADLINE_ABS_HEADER,
+    DEADLINE_HEADER,
+    PRIORITY_HEADER,
+)
 
 DEADLINE_EXPIRED = prom.REGISTRY.counter(
     names.ENGINE_DEADLINE_EXPIRED_TOTAL,

@@ -62,6 +62,11 @@ from kubeflow_tpu.models.transformer import (
     TransformerLM,
     init_kv_cache,
 )
+from kubeflow_tpu.obs.trace import (
+    TRACER,
+    ctx_from_headers,
+    observe_request_latency,
+)
 from kubeflow_tpu.serve.deadline import (
     ADMISSION_SHED,
     DEADLINE_EXPIRED,
@@ -161,6 +166,9 @@ class _PendingChunk:
     eos: Any = None    # (B, T) a live EOS landed in this step's span
     prop: Any = None   # (B, T) draft tokens proposed (live rows)
     acc: Any = None    # (B, T) draft tokens accepted (live rows)
+    # dispatch stamp (time.monotonic) — the drain records one
+    # ``decode.chunk`` span per traced resident row from this
+    t_dispatch: float = 0.0
 
 
 @dataclass
@@ -187,16 +195,78 @@ class _Request:
     # set on admission:
     row: int = -1
     gen_start: int = 0
+    # request tracing (obs/trace.py) — only populated for requests whose
+    # submit carried a trace context; warmup and untraced callers pay
+    # nothing on this path. ``espan`` is the engine-stage span, qspan /
+    # pspan its queue.wait / prefill children; all are closed by
+    # finish() from whatever terminal state the request reached.
+    model: str = "engine"
+    espan: Any = None
+    qspan: Any = None
+    pspan: Any = None
+    t_enqueue: float = 0.0
+    t_first: float = 0.0
+    t_last: float = 0.0
 
     def push(self, toks: list[int]) -> None:
+        if toks and self.t_enqueue:
+            # TTFT/TPOT stamps for traced requests: first / latest token
+            # arrival at the host (the moment a client could see them)
+            self.t_last = time.monotonic()
+            if not self.tokens:
+                self.t_first = self.t_last
         self.tokens.extend(toks)
         if self.live is not None and toks:
             self.live.put(list(toks))
 
     def finish(self) -> None:
+        self._end_trace()
         if self.live is not None:
             self.live.put(None)  # stream sentinel
         self.done.set()
+
+    def _end_trace(self) -> None:
+        """Close the engine-stage spans from the request's terminal state
+        and record its TTFT/TPOT. Idempotent (finish can race between the
+        enqueue path and the drain): the espan handle is taken once."""
+        span, self.espan = self.espan, None
+        if span is None:
+            return
+        err = self.error
+        if err is None:
+            status = "cancelled" if self.cancelled.is_set() else "ok"
+        elif isinstance(err, DeadlineExceeded):
+            status = "deadline"
+            span.event("deadline_expired", stage=err.stage)
+        elif isinstance(err, AdmissionShed):
+            status = "shed"
+            span.event("admission_shed", reason=err.reason)
+        elif type(err).__name__ == "EngineRestarting":
+            # watchdog poisoned this engine instance mid-flight; the
+            # failure is retryable on a fresh engine / peer replica
+            status = "poisoned"
+            span.event("watchdog_poisoned", retryable=True)
+        else:
+            status = "error"
+            span.set_attr("error", f"{type(err).__name__}: {err}")
+        for sub in (self.qspan, self.pspan):
+            if sub is not None:
+                sub.end(status if status != "ok" else None)
+        self.qspan = self.pspan = None
+        n = len(self.tokens)
+        span.set_attr("tokens_emitted", n)
+        if self.t_first:
+            ttft_ms = (self.t_first - self.t_enqueue) * 1e3
+            tpot_ms = None
+            if n >= 2 and self.t_last > self.t_first:
+                tpot_ms = (self.t_last - self.t_first) / (n - 1) * 1e3
+            span.set_attr("ttft_ms", round(ttft_ms, 3))
+            if tpot_ms is not None:
+                span.set_attr("tpot_ms", round(tpot_ms, 3))
+            observe_request_latency(
+                self.model, ttft_ms=ttft_ms, tpot_ms=tpot_ms
+            )
+        span.end(status)
 
 
 class EngineOverloaded(RuntimeError):
@@ -289,6 +359,9 @@ class LMEngine:
         enable_compilation_cache()  # engine start is compile-dominated
         self.model, self.cfg = model, cfg
         self.mesh = mesh
+        #: label for engine-stage spans and the TTFT/TPOT histograms;
+        #: LMEngineModel stamps its serving-model name here
+        self.model_name = "engine"
         #: paged KV mode (the vLLM block-table analog, serve/paging.py):
         #: HBM holds kv_pool_tokens tokens TOTAL instead of a
         #: (max_batch, max_seq) rectangle — admission is bounded by pages,
@@ -1152,6 +1225,7 @@ class LMEngine:
     def _enqueue(
         self, ids, max_new_tokens, temperature, *, live: bool,
         deadline: float | None = None, priority: int = 0,
+        trace: Any = None,
     ) -> _Request:
         if not ids:
             raise ValueError("empty prompt")
@@ -1249,6 +1323,22 @@ class LMEngine:
             live=queue.Queue() if live else None,
             deadline=deadline, priority=priority,
         )
+        if trace is not None:
+            # engine-stage span under the caller's wire context (a Span or
+            # a parsed TraceContext — both carry trace_id/span_id); its
+            # queue.wait child covers admission-queue time and is closed
+            # by _admit
+            espan = TRACER.span("engine", parent=trace)
+            if espan:
+                espan.set_attr("model", self.model_name)
+                espan.set_attr("prompt_tokens", len(req.ids))
+                espan.set_attr("max_new_tokens", max_new_tokens)
+                if priority:
+                    espan.set_attr("priority", priority)
+                req.model = self.model_name
+                req.espan = espan
+                req.qspan = TRACER.span("queue.wait", parent=espan)
+                req.t_enqueue = time.monotonic()
         self._pending.put(req)
         self._work.set()
         if (
@@ -1300,15 +1390,18 @@ class LMEngine:
         timeout_s: float = 300.0,
         deadline: float | None = None,
         priority: int = 0,
+        trace: Any = None,
     ) -> list[int]:
         """``deadline`` (absolute ``time.monotonic()``) is the end-to-end
         budget; ``timeout_s`` is the legacy knob and becomes the deadline
-        when none is given — one clock governs queue wait AND decode."""
+        when none is given — one clock governs queue wait AND decode.
+        ``trace`` (a Span or parsed TraceContext) parents the engine-stage
+        spans; None (warmup, untraced callers) records nothing."""
         if deadline is None:
             deadline = time.monotonic() + timeout_s
         req = self._enqueue(
             ids, max_new_tokens, temperature, live=False,
-            deadline=deadline, priority=priority,
+            deadline=deadline, priority=priority, trace=trace,
         )
         if not req.done.wait(max(0.0, deadline - time.monotonic())):
             # hand the row back: a timed-out caller must not leave its
@@ -1330,6 +1423,7 @@ class LMEngine:
         timeout_s: float = 300.0,
         deadline: float | None = None,
         priority: int = 0,
+        trace: Any = None,
     ):
         """Yields lists of new tokens as decode chunks complete — the
         streaming data path (KServe v2 generate_stream analog).
@@ -1341,7 +1435,7 @@ class LMEngine:
             deadline = time.monotonic() + timeout_s
         req = self._enqueue(
             ids, max_new_tokens, temperature, live=True,
-            deadline=deadline, priority=priority,
+            deadline=deadline, priority=priority, trace=trace,
         )
         try:
             while True:
@@ -1584,6 +1678,17 @@ class LMEngine:
             self.stats["kv_pages_used_peak"] = max(
                 self.stats["kv_pages_used_peak"], self.pager.used_pages
             )
+        if req.qspan is not None:
+            req.qspan.end()
+            req.qspan = None
+        if req.espan is not None:
+            req.pspan = (
+                TRACER.span("prefill", parent=req.espan)
+                .set_attr("row", row)
+                .set_attr("prefix_hit", base > 0)
+                .set_attr("prefix_tokens_reused", base)
+                .set_attr("pieces", n_pieces)
+            )
         self._prefilling[row] = {
             "req": req, "rest": rest, "base": base, "C": C,
             "n_pieces": n_pieces, "piece": 0,
@@ -1640,6 +1745,9 @@ class LMEngine:
         if not final:
             return  # tok is a throwaway sample from a non-final position
         del self._prefilling[row]
+        if req.pspan is not None:
+            req.pspan.end()
+            req.pspan = None
         if self._prefix_cache is not None:
             self._store_prefix(req.ids, row)
         tok = int(tok)
@@ -1926,6 +2034,7 @@ class LMEngine:
             toks=toks, valid=valid, last_tok=tok, gen_count=gen_count,
             active_out=active, active_in=active_in,
             slots=list(self._slots), eos=eos, prop=prop, acc=acc,
+            t_dispatch=time.monotonic(),
         )
 
     def _drain_chunk(self, p: _PendingChunk) -> None:
@@ -1999,6 +2108,18 @@ class LMEngine:
                         break
                     fresh.append(int(toks[row, j]))
             req.push(fresh)
+            if req.espan is not None and fresh:
+                # retroactive decode.chunk span (host ints only): stamped
+                # at dispatch, reported here so the loop never holds an
+                # open span per chunk
+                attrs: dict[str, Any] = {"row": row, "tokens": len(fresh)}
+                if self.spec_k:
+                    attrs["spec_proposed"] = row_prop
+                    attrs["spec_accepted"] = row_acc
+                TRACER.record_span(
+                    "decode.chunk", parent=req.espan,
+                    start=p.t_dispatch, end=time.monotonic(), attrs=attrs,
+                )
             # lazy mirror refresh from the drained outputs — the only place
             # host state learns device progress; per-row (not wholesale) so
             # rows edited by admit/prefill keep their newer host values
@@ -2256,7 +2377,7 @@ class LMEngineModel(LMRuntimeModel):
         first, the watchdog's supervised restart builds replacements
         (fresh KV cache / pager / prefix cache / carry; params reused —
         they are never donated, only the cache is)."""
-        return LMEngine(
+        eng = LMEngine(
             self._model, self.config, self._params,
             max_batch=self._engine_max_batch,
             max_seq=self._engine_max_seq,
@@ -2276,6 +2397,9 @@ class LMEngineModel(LMRuntimeModel):
             paged_attn_impl=self._engine_paged_attn_impl,
             kv_quant=self._engine_kv_quant,
         )
+        # engine spans and TTFT/TPOT histograms label by serving model
+        eng.model_name = self.name
+        return eng
 
     def restart_engine(self, err: Exception | None = None) -> LMEngine:
         """Tear down and rebuild the engine's device state. The watchdog's
@@ -2426,7 +2550,8 @@ class LMEngineModel(LMRuntimeModel):
             eng.overlap[key] = 0 if key == "carry_uploads" else 0.0
 
     def _submit_row(
-        self, row, deadline: float | None = None, priority: int = 0
+        self, row, deadline: float | None = None, priority: int = 0,
+        trace: Any = None,
     ) -> dict:
         toks = self.engine.submit(
             row["ids"],
@@ -2434,6 +2559,7 @@ class LMEngineModel(LMRuntimeModel):
             temperature=row["temperature"],
             deadline=deadline,
             priority=priority,
+            trace=trace,
         )
         return {"token_ids": toks}
 
@@ -2464,9 +2590,12 @@ class LMEngineModel(LMRuntimeModel):
 
         deadline = deadline_from_headers(headers)
         priority = priority_from_headers(headers)
+        ctx = ctx_from_headers(headers)
         self._admit(len(rows))
         futs = [
-            self._executor.submit(self._submit_row, r, deadline, priority)
+            self._executor.submit(
+                self._submit_row, r, deadline, priority, ctx
+            )
             for r in rows
         ]
         try:
@@ -2483,6 +2612,7 @@ class LMEngineModel(LMRuntimeModel):
         before its first next() (a bare generator's finally wouldn't run)."""
         deadline = deadline_from_headers(headers)
         priority = priority_from_headers(headers)
+        ctx = ctx_from_headers(headers)
         self._admit(1)
         gen = self.engine.stream(
             row["ids"],
@@ -2490,6 +2620,7 @@ class LMEngineModel(LMRuntimeModel):
             temperature=row["temperature"],
             deadline=deadline,
             priority=priority,
+            trace=ctx,
         )
         return _AdmittedStream(gen, lambda: self._release(1))
 
@@ -2499,6 +2630,7 @@ class LMEngineModel(LMRuntimeModel):
         rows = self.preprocess(payload, headers)
         deadline = deadline_from_headers(headers)
         priority = priority_from_headers(headers)
+        ctx = ctx_from_headers(headers)
         self._admit(len(rows))
         try:
             loop = asyncio.get_running_loop()
@@ -2509,7 +2641,7 @@ class LMEngineModel(LMRuntimeModel):
                 *[
                     loop.run_in_executor(
                         self._executor, self._submit_row, r, deadline,
-                        priority,
+                        priority, ctx,
                     )
                     for r in rows
                 ],

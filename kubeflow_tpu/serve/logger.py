@@ -13,6 +13,8 @@ import time
 import uuid
 from typing import Any, Callable
 
+from kubeflow_tpu.obs import trace
+
 
 def _json_fallback(obj: Any):
     if hasattr(obj, "tolist"):  # numpy arrays and scalars
@@ -38,23 +40,26 @@ class RequestLogger:
         self._file.write(json.dumps(event, default=_json_fallback) + "\n")
 
     def _emit(self, event_type: str, model: str, req_id: str, payload: Any) -> None:
-        self._sink(
-            {
-                # CloudEvents v1.0 envelope attributes
-                "specversion": "1.0",
-                "id": str(uuid.uuid4()),
-                "source": f"kubeflow-tpu/serve/{model}",
-                "type": event_type,
-                # CloudEvents event stamps are wall-clock BY CONTRACT
-                # (consumers correlate them across hosts); this value is
-                # never subtracted from another stamp — all latency math
-                # in serve/ runs on monotonic/perf_counter clocks
-                "time": time.time(),  # kft: noqa[monotonic-clock] — CloudEvents wall-clock timestamp, never used in interval arithmetic
-                "inferenceserviceid": model,
-                "requestid": req_id,
-                "data": payload,
-            }
-        )
+        event = {
+            # CloudEvents v1.0 envelope attributes
+            "specversion": "1.0",
+            "id": str(uuid.uuid4()),
+            "source": f"kubeflow-tpu/serve/{model}",
+            "type": event_type,
+            # CloudEvents event stamps are wall-clock BY CONTRACT
+            # (consumers correlate them across hosts); this value is
+            # never subtracted from another stamp — all latency math
+            # in serve/ runs on monotonic/perf_counter clocks
+            "time": time.time(),  # kft: noqa[monotonic-clock] — CloudEvents wall-clock timestamp, never used in interval arithmetic
+            "inferenceserviceid": model,
+            "requestid": req_id,
+            "data": payload,
+        }
+        ids = trace.current_ids()
+        if ids is not None:
+            # `grep trace_id` across replica logs reconstructs a request
+            event["trace_id"], event["span_id"] = ids
+        self._sink(event)
 
     def log_request(self, model: str, req_id: str, payload: Any) -> None:
         self._emit("org.kubeflow.serving.inference.request", model, req_id, payload)

@@ -30,6 +30,13 @@ from typing import Any
 from aiohttp import web
 
 from kubeflow_tpu.obs import names, prom
+from kubeflow_tpu.obs import trace as _trace
+from kubeflow_tpu.obs.trace import (
+    TRACE_HEADER,
+    TRACER,
+    ctx_from_headers,
+    to_perfetto,
+)
 from kubeflow_tpu.serve import protocol
 from kubeflow_tpu.serve.batcher import Batcher, BatcherConfig
 from kubeflow_tpu.serve.deadline import (
@@ -67,6 +74,20 @@ def _shed_response(e: Exception) -> web.HTTPException | None:
     if isinstance(e, EngineOverloaded):
         return web.HTTPTooManyRequests(reason=str(e))
     return None
+
+
+def _span_status(e: BaseException) -> str:
+    """Span terminal status for the SRE error taxonomy — shed/deadline/
+    poisoned statuses put the trace in the tail-sampler's keep pool."""
+    if isinstance(e, DeadlineExceeded):
+        return "deadline"
+    if isinstance(e, (AdmissionShed, EngineOverloaded)):
+        return "shed"
+    if isinstance(e, EngineRestarting):
+        return "poisoned"
+    if isinstance(e, asyncio.CancelledError):
+        return "cancelled"
+    return "error"
 
 #: Batcher occupancy gauges (per model) on the process-wide registry, so the
 #: ObsServer's shared /metrics shows them next to the engine pool gauges;
@@ -367,29 +388,54 @@ class DataPlane:
         req_id = headers.get("x-request-id") or headers.get(
             "X-Request-Id", str(uuid.uuid4())
         )
-        if self.logger is not None:
-            self.logger.log_request(name, req_id, payload)
-        t0 = time.perf_counter()
-        self.inflight[name] = self.inflight.get(name, 0) + 1
+        # request tracing: continue the wire context (gateway/client) or
+        # mint a fresh trace for direct-to-replica traffic; the restamped
+        # header parents the engine-stage spans, and the ambient span
+        # correlates the audit log lines below
+        span = TRACER.span("dataplane", ctx=ctx_from_headers(headers))
+        ctok = None
+        if span:
+            span.set_attr("model", name)
+            span.set_attr("request_id", req_id)
+            headers[TRACE_HEADER] = span.header()
+            ctok = _trace.set_current(span)
         try:
-            batcher = self._batchers.get(name)
-            if batcher is not None and isinstance(payload, dict) and "instances" in payload:
-                preds = await batcher.submit(
-                    list(payload["instances"]), deadline=deadline
-                )
-                result: Any = {"predictions": preds}
-            else:
-                result = await model(payload, headers)
+            if self.logger is not None:
+                self.logger.log_request(name, req_id, payload)
+            t0 = time.perf_counter()
+            self.inflight[name] = self.inflight.get(name, 0) + 1
+            try:
+                batcher = self._batchers.get(name)
+                if batcher is not None and isinstance(payload, dict) and "instances" in payload:
+                    preds = await batcher.submit(
+                        list(payload["instances"]), deadline=deadline,
+                        trace=span if span else None,
+                    )
+                    result: Any = {"predictions": preds}
+                else:
+                    result = await model(payload, headers)
+            except BaseException as e:
+                if span:
+                    status = _span_status(e)
+                    if status == "error":
+                        span.set_attr("error", f"{type(e).__name__}: {e}")
+                    span.end(status)
+                raise
+            finally:
+                self.inflight[name] = max(0, self.inflight.get(name, 0) - 1)
+            dt = (time.perf_counter() - t0) * 1e3
+            self.metrics["requests_total"][name] = self.metrics["requests_total"].get(name, 0) + 1
+            # bounded reservoir: long-lived servers must not accumulate a
+            # sample per request forever
+            self.metrics["latency_ms"].setdefault(name, deque(maxlen=4096)).append(dt)
+            if self.logger is not None:
+                self.logger.log_response(name, req_id, result)
+            if span:
+                span.end()
+            return result
         finally:
-            self.inflight[name] = max(0, self.inflight.get(name, 0) - 1)
-        dt = (time.perf_counter() - t0) * 1e3
-        self.metrics["requests_total"][name] = self.metrics["requests_total"].get(name, 0) + 1
-        # bounded reservoir: long-lived servers must not accumulate a sample
-        # per request forever
-        self.metrics["latency_ms"].setdefault(name, deque(maxlen=4096)).append(dt)
-        if self.logger is not None:
-            self.logger.log_response(name, req_id, result)
-        return result
+            if ctok is not None:
+                _trace.reset_current(ctok)
 
     async def explain(self, name: str, payload: Any, headers=None) -> Any:
         model = self.get(name)
@@ -453,6 +499,10 @@ class ModelServer:
         dp = self.dataplane
         app.router.add_get("/", lambda r: web.json_response({"status": "alive"}))
         app.router.add_get("/metrics", self._metrics)
+        # tail-sampled request traces (obs/trace.py):
+        # ?limit=N bounds the reply, ?format=perfetto converts to
+        # Chrome/Perfetto trace_event JSON (what `kft trace dump` reads)
+        app.router.add_get("/debug/traces", self._debug_traces)
         app.router.add_get(
             "/v1/models", lambda r: web.json_response({"models": dp.list_models()})
         )
@@ -558,6 +608,16 @@ class ModelServer:
             row = model.preprocess({"instances": [body]})[0]
         except Exception as e:
             raise web.HTTPBadRequest(reason=str(e))
+        # streamed requests get their own dataplane-stage span — same wire
+        # contract as infer(): continue the gateway/client context or mint
+        # one, restamp the header so the engine spans parent correctly
+        span = TRACER.span(
+            "dataplane.stream", ctx=ctx_from_headers(dict(req.headers))
+        )
+        ctok = None
+        if span:
+            span.set_attr("model", name)
+            ctok = _trace.set_current(span)
         # streamed requests ride the same accounting as the DataPlane hot
         # path — /metrics, the audit log, AND the deadline contract
         req_id = req.headers.get("x-request-id", str(uuid.uuid4()))
@@ -572,8 +632,17 @@ class ModelServer:
             # here, before any response bytes commit, and becomes a clean
             # 429 (overload) or 503 + Retry-After (deadline shed)
             hdrs, _ = self.dataplane.effective_headers(dict(req.headers))
+            if span:
+                hdrs[TRACE_HEADER] = span.header()
             gen = stream_rows(row, hdrs)
         except Exception as e:
+            if span:
+                status = _span_status(e)
+                if status == "error":
+                    span.set_attr("error", f"{type(e).__name__}: {e}")
+                span.end(status)
+            if ctok is not None:
+                _trace.reset_current(ctok)
             shed = _shed_response(e)
             if shed is None:
                 raise
@@ -650,6 +719,13 @@ class ModelServer:
                     {"predictions": [{"token_ids": streamed}],
                      "streamed": True, "complete": not disconnected.is_set()},
                 )
+            if span:
+                span.set_attr("tokens_streamed", total)
+                span.end(
+                    "cancelled" if disconnected.is_set() else None
+                )
+            if ctok is not None:
+                _trace.reset_current(ctok)
         return resp
 
     # -- prefix-KV peer transfer ------------------------------------------ #
@@ -805,6 +881,16 @@ class ModelServer:
 
         return web.json_response(protocol.encode_v2(name, np.asarray(preds)))
 
+    async def _debug_traces(self, req: web.Request) -> web.Response:
+        try:
+            limit = int(req.query.get("limit", "64"))
+        except ValueError:
+            raise web.HTTPBadRequest(reason="limit must be an integer")
+        snap = TRACER.snapshot(limit=max(1, min(limit, 256)))
+        if req.query.get("format") == "perfetto":
+            return web.json_response(to_perfetto(snap))
+        return web.json_response(snap)
+
     async def _metrics(self, req: web.Request) -> web.Response:
         lines = []
         for name, n in self.dataplane.metrics["requests_total"].items():
@@ -954,6 +1040,11 @@ class ModelServer:
                     f'{names.ENGINE_RESTARTS_TOTAL}{{model="{name}"}} '
                     f'{wd.stats["restarts"]}'
                 )
+        # server-side TTFT/TPOT histograms (obs/trace.py) — per-replica
+        # exposition so smoke/e2e assertions read them without the shared
+        # ObsServer registry scrape
+        lines.extend(_trace.TTFT_MS.expose())
+        lines.extend(_trace.TPOT_MS.expose())
         return web.Response(text="\n".join(lines) + "\n")
 
     # -- runtime ------------------------------------------------------------

@@ -757,4 +757,132 @@ async def main():
 asyncio.run(main())
 EOF
 
+echo "== tracing: one trace id gateway edge -> decode chunk, TTFT/TPOT histograms, Perfetto export =="
+python - <<'EOF'
+import asyncio, json, urllib.request
+
+import jax, jax.numpy as jnp
+
+from kubeflow_tpu.gateway.router import ServiceRoute
+from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from kubeflow_tpu.obs.trace import TRACER, TraceContext, to_perfetto
+from kubeflow_tpu.serve.engine import LMEngineModel
+from kubeflow_tpu.serve.model import BucketSpec
+from kubeflow_tpu.serve.server import ModelServer
+
+cfg = TransformerConfig(vocab_size=89, d_model=32, n_layers=2, n_heads=4,
+                        d_ff=64, causal=True, max_seq_len=256,
+                        attn_impl="reference", dtype=jnp.float32)
+tlm = TransformerLM(cfg)
+params = tlm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def replica():
+    m = LMEngineModel(
+        "m", None, config=cfg, max_batch=4, chunk_steps=2,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+        max_new_tokens=6, eos_id=1,
+    )
+    m.load()
+    m._params = jax.device_put(params)
+    m.engine.stop()
+    m.engine = m._make_engine().start()
+    return m
+
+
+async def main():
+    TRACER.sample_every = 1  # keep every trace in this tiny burst
+    m_a, m_b = replica(), replica()
+    ms_a = ModelServer([m_a], http_port=0)
+    ms_b = ModelServer([m_b], http_port=0)
+    await ms_a.start_async()
+    await ms_b.start_async()
+
+    def port_of(ms):
+        (site,) = ms._runner.sites
+        return site._server.sockets[0].getsockname()[1]
+
+    pa, pb = port_of(ms_a), port_of(ms_b)
+    gw = InferenceGateway(GatewayConfig(
+        probe_interval_s=0.25,
+        routes=[ServiceRoute(name="m")],
+        backends=[("m", f"http://127.0.0.1:{pa}", "default"),
+                  ("m", f"http://127.0.0.1:{pb}", "default")],
+    ), http_port=0)
+    await gw.start_async()
+    loop = asyncio.get_running_loop()
+    ctx = TraceContext("ab" * 16, "cd" * 8)  # the "client SDK" span
+
+    def predict(i, extra=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.http_port}/v1/models/m:predict",
+            data=json.dumps(
+                {"instances": [{"input_ids": [3 + i % 5, 4, 5]}]}
+            ).encode(),
+            headers={"Content-Type": "application/json", **(extra or {})},
+        )
+        with urllib.request.urlopen(req, timeout=180) as r:
+            return r.status
+
+    def fetch(url):
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.read().decode()
+
+    try:
+        for i in range(6):
+            assert await loop.run_in_executor(None, predict, i) == 200
+        assert await loop.run_in_executor(
+            None, predict, 99, {"x-kft-trace": ctx.header()}) == 200
+
+        # the client-stamped trace covers EVERY hop, edge to decode chunk
+        snap = TRACER.snapshot(limit=64)
+        tr = next(t for t in snap["traces"] if t["trace_id"] == ctx.trace_id)
+        names = {s["name"] for s in tr["spans"]}
+        need = {"route", "proxy", "dataplane", "engine",
+                "queue.wait", "prefill", "decode.chunk"}
+        assert need <= names, f"span tree incomplete: {sorted(names)}"
+        route = next(s for s in tr["spans"] if s["name"] == "route")
+        assert route["parent_span_id"] == ctx.span_id
+
+        # the replica's own /debug/traces serves its half of the story
+        replica_snap = None
+        for port in (pa, pb):
+            doc = json.loads(await loop.run_in_executor(
+                None, fetch, f"http://127.0.0.1:{port}/debug/traces?limit=64"))
+            hit = [t for t in doc["traces"] if t["trace_id"] == ctx.trace_id]
+            if hit:
+                replica_snap = hit[0]
+        assert replica_snap is not None, "trace missing from /debug/traces"
+        assert any(s["name"] == "decode.chunk" for s in replica_snap["spans"])
+
+        # Perfetto conversion round-trips through JSON
+        perfetto = to_perfetto(snap)
+        assert any(e.get("ph") == "X" for e in json.loads(
+            json.dumps(perfetto))["traceEvents"])
+
+        # completed streams fed the TTFT/TPOT histograms
+        ttft = tpot = 0.0
+        for port in (pa, pb):
+            for ln in (await loop.run_in_executor(
+                    None, fetch, f"http://127.0.0.1:{port}/metrics")).splitlines():
+                if ln.startswith('kft_server_ttft_ms_count{model="m"}'):
+                    ttft += float(ln.rsplit(" ", 1)[1])
+                if ln.startswith('kft_server_tpot_ms_count{model="m"}'):
+                    tpot += float(ln.rsplit(" ", 1)[1])
+        assert ttft >= 1, f"TTFT observations missing: {ttft}"
+        assert tpot >= 1, f"TPOT observations missing: {tpot}"
+        print(f"tracing OK: {len(tr['spans'])} spans edge->decode under one "
+              f"trace id, ttft_count={ttft:.0f} tpot_count={tpot:.0f}, "
+              f"perfetto events={len(perfetto['traceEvents'])}")
+    finally:
+        await gw.stop_async()
+        m_a.unload()
+        m_b.unload()
+        await ms_a.stop_async()
+        await ms_b.stop_async()
+
+asyncio.run(main())
+EOF
+
 echo "smoke OK"

@@ -1278,6 +1278,28 @@ def test_wedged_engine_behind_gateway_watchdog_restart_zero_failures():
                            service="m") > retries0
             assert m_a.ready and m_b.ready  # replica A recovered
 
+            # the poisoned trace is tail-kept: one trace id carries the
+            # wedged replica's engine span (poisoned + watchdog event)
+            # AND the retry that landed on the healthy peer
+            from kubeflow_tpu.obs.trace import TRACER, TTFT_MS
+            snap = TRACER.snapshot(limit=64)
+            poisoned = [
+                t for t in snap["traces"]
+                if any(s["status"] == "poisoned" for s in t["spans"])
+            ]
+            assert poisoned, "watchdog poison must survive tail sampling"
+            tr = poisoned[0]
+            assert any(
+                ev["name"] == "watchdog_poisoned"
+                for s in tr["spans"] for ev in s["events"]
+            )
+            engine_spans = [s for s in tr["spans"] if s["name"] == "engine"]
+            assert {s["status"] for s in engine_spans} >= {"poisoned", "ok"}
+            assert len({s["span_id"] for s in tr["spans"]
+                        if s["name"] == "proxy"}) >= 2
+            # completed streams fed the latency histograms
+            assert TTFT_MS.labels(model="m").count > 0
+
             # the correctly-shed tail: an already-expired budget is 503 +
             # Retry-After at the edge and costs NEITHER engine a slot
             admitted = (m_a.engine.stats["admitted"],

@@ -1,0 +1,405 @@
+"""End-to-end request tracing tests (obs/trace.py): W3C-style context
+propagation gateway→dataplane→engine, tail-based retention, Perfetto
+export, log correlation, and the gateway failure paths (retry, hedging,
+activator parking) each leaving the span evidence an operator needs."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from kubeflow_tpu.gateway.router import ServiceRoute
+from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+from kubeflow_tpu.obs import trace as trace_mod
+from kubeflow_tpu.obs.headers import TRACE_HEADER
+from kubeflow_tpu.obs.trace import (
+    TRACER,
+    TraceContext,
+    Tracer,
+    ctx_from_headers,
+    to_perfetto,
+)
+from kubeflow_tpu.serve.batcher import BatcherConfig
+from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.serve.server import ModelServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Isolate each test from the process-global tracer, and sample at
+    1-in-1 so healthy traces are deterministically retained."""
+    TRACER.clear()
+    old = TRACER.sample_every
+    TRACER.sample_every = 1
+    yield
+    TRACER.sample_every = old
+    TRACER.clear()
+
+
+# ------------------------------------------------------------- context
+
+
+def test_trace_context_header_roundtrip_and_casing():
+    ctx = TraceContext("ab" * 16, "cd" * 8)
+    parsed = TraceContext.parse(ctx.header())
+    assert parsed is not None
+    assert (parsed.trace_id, parsed.span_id) == (ctx.trace_id, ctx.span_id)
+    assert parsed.sampled
+    # the sampled flag survives the roundtrip both ways
+    off = TraceContext.parse(f"00-{'a' * 32}-{'b' * 16}-00")
+    assert off is not None and not off.sampled
+    # aiohttp title-cases headers; both spellings must resolve
+    for key in (TRACE_HEADER, TRACE_HEADER.title()):
+        got = ctx_from_headers({key: ctx.header()})
+        assert got is not None and got.trace_id == ctx.trace_id
+
+
+def test_trace_context_rejects_malformed_headers():
+    bad = [
+        "",
+        "garbage",
+        "00-xyz-123-01",                        # non-hex
+        f"00-{'a' * 31}-{'b' * 16}-01",         # short trace id
+        f"00-{'0' * 32}-{'b' * 16}-01",         # all-zero trace id
+        f"00-{'a' * 32}-{'0' * 16}-01",         # all-zero span id
+        f" zz-{'a' * 32}-{'b' * 16}-01",        # bad version
+    ]
+    for h in bad:
+        assert TraceContext.parse(h) is None, h
+    assert ctx_from_headers({}) is None
+
+
+# ------------------------------------------------------------- sampler
+
+
+def test_tail_sampler_keeps_every_failure_class_and_samples_ok():
+    tr = Tracer(sample_every=16)
+    for status in ("error", "shed", "deadline", "poisoned"):
+        tr.span(f"req-{status}").end(status)
+    for _ in range(64):
+        tr.span("req-ok").end()
+    snap = tr.snapshot(limit=128)
+    kept = {t["kept"] for t in snap["traces"]}
+    # 100% of the failure classes survive; the healthy majority is
+    # down-sampled 1-in-16
+    assert {"error", "shed", "deadline", "poisoned"} <= kept
+    sampled = [t for t in snap["traces"] if t["kept"] == "sampled"]
+    assert 1 <= len(sampled) <= 8
+    assert snap["finished"] == 68
+
+
+def test_tail_sampler_memory_stays_bounded_under_error_storm():
+    tr = Tracer()
+    for _ in range(1000):
+        tr.span("boom").end("error")
+    # the ring keeps the newest 256 — bounded memory, not unbounded keep
+    assert len(tr._errors) == 256
+    snap = tr.snapshot(limit=64)
+    assert len(snap["traces"]) == 64
+    assert all(t["kept"] == "error" for t in snap["traces"])
+    assert not tr._live  # nothing leaked open
+
+
+def test_disabled_tracer_is_falsy_noop_everywhere():
+    tr = Tracer(enabled=False)
+    span = tr.span("route")
+    assert not span  # `if span:` guards skip all stamping work
+    span.set_attr("k", "v")
+    span.event("e")
+    span.end("error")
+    tr.record_span("decode.chunk", parent=span, start=0.0, end=1.0)
+    assert tr.snapshot()["traces"] == []
+    assert tr.snapshot()["finished"] == 0
+
+
+# ------------------------------------------------------------- export
+
+
+def test_perfetto_export_is_valid_trace_event_json():
+    tr = Tracer(sample_every=1)
+    root = tr.span("route")
+    child = tr.span("proxy", parent=root)
+    child.event("retry", attempt=1)
+    child.end()
+    root.end("error")
+    doc = to_perfetto(tr.snapshot())
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    json.dumps(doc)  # loadable by ui.perfetto.dev ⇒ must serialize clean
+    by_phase: dict = {}
+    for ev in doc["traceEvents"]:
+        by_phase.setdefault(ev["ph"], []).append(ev)
+    assert {e["name"] for e in by_phase["X"]} == {"route", "proxy"}
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in by_phase["X"])
+    assert [e["name"] for e in by_phase["i"]] == ["retry"]
+    assert by_phase["M"], "process_name metadata frames the timeline"
+
+
+# ----------------------------------------------------- log correlation
+
+
+def test_log_records_and_cloudevents_carry_ambient_trace_ids():
+    from kubeflow_tpu.obs.jsonlog import JsonFormatter
+    from kubeflow_tpu.serve.logger import RequestLogger
+
+    def record():
+        return logging.LogRecord(
+            "t", logging.INFO, __file__, 1, "hello", (), None
+        )
+
+    span = TRACER.span("dataplane")
+    tok = trace_mod.set_current(span)
+    try:
+        entry = json.loads(JsonFormatter().format(record()))
+        assert entry["trace_id"] == span.trace_id
+        assert entry["span_id"] == span.span_id
+        lg = RequestLogger()
+        lg.log_request("m", "r1", {"x": 1})
+        assert lg.entries[0]["trace_id"] == span.trace_id
+        assert lg.entries[0]["span_id"] == span.span_id
+    finally:
+        trace_mod.reset_current(tok)
+        span.end()
+    # outside the contextvar scope the fields are simply absent
+    entry = json.loads(JsonFormatter().format(record()))
+    assert "trace_id" not in entry and "span_id" not in entry
+
+
+# ---------------------------------------------------- serve endpoints
+
+
+class _M(Model):
+    def __init__(self, name="m"):
+        super().__init__(name)
+        self.ready = True
+
+    def predict(self, inputs, headers=None):
+        return {"predictions": [0 for _ in inputs["instances"]]}
+
+
+async def _server_client(ms):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(ms.build_app()))
+    await client.start_server()
+    return client
+
+
+def test_debug_traces_endpoint_continues_client_context():
+    async def run():
+        ms = ModelServer(
+            [_M()], batcher=BatcherConfig(max_batch_size=4, max_latency_ms=1.0)
+        )
+        client = await _server_client(ms)
+        try:
+            ctx = TraceContext("ab" * 16, "12" * 8)
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[1]]},
+                headers={TRACE_HEADER: ctx.header()},
+            )
+            assert r.status == 200
+            r = await client.get("/debug/traces?limit=8")
+            assert r.status == 200
+            snap = await r.json()
+            tr = next(
+                t for t in snap["traces"] if t["trace_id"] == ctx.trace_id
+            )
+            dp = next(s for s in tr["spans"] if s["name"] == "dataplane")
+            # the client-minted span is the dataplane span's remote parent
+            assert dp["parent_span_id"] == ctx.span_id
+            assert dp["status"] == "ok"
+            # batched path: queue-wait and flush spans join the same tree
+            names = {s["name"] for s in tr["spans"]}
+            assert {"batcher.wait", "batcher.flush"} <= names
+            r = await client.get("/debug/traces?format=perfetto&limit=8")
+            doc = await r.json()
+            assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------- gateway paths
+
+
+async def _gateway_client(gw):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(gw.build_app()))
+    await client.start_server()
+    return client
+
+
+async def _raw_backend(predict_handler):
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    async def ready(request):
+        return web.json_response({"ready": True})
+
+    app = web.Application()
+    app.router.add_get("/v2/health/ready", ready)
+    app.router.add_post("/v1/models/m:predict", predict_handler)
+    srv = TestServer(app)
+    await srv.start_server()
+    return srv, f"http://127.0.0.1:{srv.port}"
+
+
+def test_gateway_retry_keeps_one_trace_with_distinct_attempt_spans():
+    from aiohttp import web
+
+    async def run():
+        calls = {"n": 0}
+
+        async def predict(request):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return web.Response(status=502, text="boom")
+            # the retried attempt must arrive under the SAME trace id but
+            # a FRESH attempt span id (stamped per attempt, not shared)
+            seen_headers.append(request.headers.get(TRACE_HEADER))
+            return web.json_response({"predictions": ["ok"]})
+
+        seen_headers: list = []
+        srv, url = await _raw_backend(predict)
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, retry_budget_floor=50,
+            backends=[("m", url, "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            ctx = TraceContext("5c" * 16, "ab" * 8)
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[1]]},
+                headers={TRACE_HEADER: ctx.header()},
+            )
+            assert r.status == 200
+            snap = TRACER.snapshot(limit=16)
+            tr = next(
+                t for t in snap["traces"] if t["trace_id"] == ctx.trace_id
+            )
+            # a failed-then-retried request is an error trace: kept 100%
+            assert tr["kept"] == "error"
+            (route,) = [s for s in tr["spans"] if s["name"] == "route"]
+            assert route["parent_span_id"] == ctx.span_id
+            assert route["status"] == "ok"
+            assert any(ev["name"] == "retry" for ev in route["events"])
+            proxies = [s for s in tr["spans"] if s["name"] == "proxy"]
+            assert len(proxies) == 2
+            assert len({p["span_id"] for p in proxies}) == 2
+            assert sorted(p["status"] for p in proxies) == ["error", "ok"]
+            assert all(
+                p["parent_span_id"] == route["span_id"] for p in proxies
+            )
+            # the wire header the backend saw names the winning attempt
+            winner = next(p for p in proxies if p["status"] == "ok")
+            got = TraceContext.parse(seen_headers[0])
+            assert got.trace_id == ctx.trace_id
+            assert got.span_id == winner["span_id"]
+        finally:
+            await client.close()
+            await srv.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_hedge_trace_marks_the_cancelled_loser():
+    from aiohttp import web
+
+    async def run():
+        async def slow(request):
+            await asyncio.sleep(0.6)
+            return web.json_response({"predictions": ["slow"]})
+
+        async def fast(request):
+            return web.json_response({"predictions": ["fast"]})
+
+        srv_slow, url_slow = await _raw_backend(slow)
+        srv_fast, url_fast = await _raw_backend(fast)
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0,
+            routes=[ServiceRoute(name="m", hedge_ms=40.0)],
+            backends=[("m", url_slow, "default"),
+                      ("m", url_fast, "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[1]]}
+            )
+            assert r.status == 200
+            assert (await r.json())["predictions"] == ["fast"]
+            snap = TRACER.snapshot(limit=8)
+            assert snap["traces"], "sampled-at-1 trace must be retained"
+            tr = snap["traces"][0]
+            proxies = [s for s in tr["spans"] if s["name"] == "proxy"]
+            assert len(proxies) == 2
+            (loser,) = [p for p in proxies if p["status"] == "cancelled"]
+            (winner,) = [p for p in proxies if p["status"] == "ok"]
+            assert loser["attrs"].get("hedge_loser") is True
+            assert loser["attrs"]["backend"] == url_slow
+            assert winner["attrs"]["backend"] == url_fast
+            assert winner["attrs"].get("hedge") is True
+        finally:
+            await client.close()
+            await srv_slow.close()
+            await srv_fast.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_cold_start_records_activator_park_span():
+    from aiohttp import web
+
+    async def run():
+        started = []
+        gw_box = {}
+
+        def scale_up(service):
+            async def spawn():
+                await asyncio.sleep(0.05)
+
+                async def predict(request):
+                    return web.json_response({"predictions": ["cold"]})
+
+                srv, url = await _raw_backend(predict)
+                started.append(srv)
+                gw_box["gw"].pool.add(service, url)
+
+            asyncio.ensure_future(spawn())
+
+        gw = InferenceGateway(
+            GatewayConfig(
+                probe_interval_s=30.0, activation_timeout_s=5.0,
+                routes=[ServiceRoute(name="m")],
+            ),
+            scale_up=scale_up,
+        )
+        gw_box["gw"] = gw
+        client = await _gateway_client(gw)
+        try:
+            ctx = TraceContext("7e" * 16, "33" * 8)
+            r = await client.post(
+                "/v1/models/m:predict", json={"instances": [[1]]},
+                headers={TRACE_HEADER: ctx.header()},
+            )
+            assert r.status == 200
+            snap = TRACER.snapshot(limit=8)
+            tr = next(
+                t for t in snap["traces"] if t["trace_id"] == ctx.trace_id
+            )
+            (park,) = [
+                s for s in tr["spans"] if s["name"] == "activator.park"
+            ]
+            assert park["status"] == "ok"
+            assert park["attrs"]["parked_depth"] >= 1
+            assert any(ev["name"] == "activated" for ev in park["events"])
+            (route,) = [s for s in tr["spans"] if s["name"] == "route"]
+            assert park["parent_span_id"] == route["span_id"]
+        finally:
+            await client.close()
+            for srv in started:
+                await srv.close()
+
+    asyncio.run(run())
