@@ -1421,6 +1421,215 @@ def _bench_spec_decode() -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# config 8b (beyond BASELINE): disaggregated prefill/decode serving.
+# Baseline = ONE colocated engine interleaving prefill chunks with decode
+# chunks on its scheduler; disagg = a prefill engine that only prefills and
+# a decode engine that only decodes, wired by the per-request KV-span ship
+# (prefill_span → npz codec → prepare_kv_span → inject) — the in-process
+# equivalent of the gateway's x-kft-prefill-peer path, minus the HTTP.
+# --------------------------------------------------------------------------- #
+
+
+def bench_engine_disagg() -> dict:
+    """TTFT/TPOT p50/p99 for disagg vs colocated under concurrent load,
+    plus KV-ship bytes and latency. CPU-runnable: on CPU the numbers are a
+    TRAJECTORY for the interference effect (decode chunks delaying new
+    requests' prefill and vice versa), not a throughput claim."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+    from kubeflow_tpu.serve.engine import LMEngine
+    from kubeflow_tpu.serve.kv_codec import decode_kv_entries, encode_kv_entries
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = TransformerConfig(
+        vocab_size=32768,
+        d_model=1024 if on_tpu else 128,
+        n_layers=12 if on_tpu else 2,
+        n_heads=16 if on_tpu else 4,
+        d_ff=4096 if on_tpu else 256,
+        causal=True,
+        attn_impl="flash" if on_tpu else "reference",
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    # the DistServe workload shape: a batch of RESIDENT rows in decode
+    # steady state, plus LONG-prompt/short-decode arrivals whose chunked
+    # prefill must (colocated) interleave with the residents' chunks
+    n_res, res_new = 4, 96
+    n_inc, inc_new = 6, 16
+    res_prompts = [
+        [int(t) for t in rng.integers(2, cfg.vocab_size, size=16)]
+        for _ in range(n_res)
+    ]
+    inc_prompts = [
+        [int(t) for t in rng.integers(2, cfg.vocab_size, size=int(n))]
+        for n in rng.integers(160, 225, size=n_inc)
+    ]
+
+    def mk() -> LMEngine:
+        # eos_id=-1: no stream ends early, so every TPOT sample sees its
+        # full budget of inter-token gaps. prefill_chunk=32 is the
+        # interference knob: a 224-token prompt is 7 pieces, each of which
+        # (colocated) waits out a 16-step decode chunk of the residents.
+        return LMEngine(
+            model, cfg, params, max_batch=n_res + n_inc, max_seq=256,
+            chunk_steps=16, prefill_buckets=(32, 256), prefill_chunk=32,
+            eos_id=-1, kv_pool_tokens=256 * 8, page_size=32,
+        ).start()
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))] * 1e3, 2)
+
+    ship = {"bytes": 0, "ships": 0, "ms": []}
+    lock = threading.Lock()
+
+    def run(pre: LMEngine | None, dec: LMEngine) -> dict:
+        """``pre is None`` → colocated (dec prefills everything itself);
+        otherwise EVERY request's prefill runs on ``pre`` and ships —
+        the decode engine must execute zero prefill pieces."""
+        # warm both shape buckets before timing
+        dec.submit(res_prompts[0][:8], max_new_tokens=2)
+        (pre or dec).submit(inc_prompts[0], max_new_tokens=2)
+        pieces0 = dec.stats["prefill_pieces"]
+        res_tpot: dict[int, float] = {}
+        inc_ttft: dict[int, float] = {}
+        outs: dict[str, list[int]] = {}
+
+        def start_stream(ids, max_new):
+            if pre is None:
+                return dec.stream(ids, max_new_tokens=max_new)
+            t0 = time.perf_counter()
+            tree, meta = pre.prefill_span(ids)
+            blob = encode_kv_entries([(tuple(ids), tree)], meta)
+            entries, m = decode_kv_entries(blob)
+            span = dec.prepare_kv_span(ids, entries[0][1], m)
+            with lock:
+                ship["bytes"] += len(blob)
+                ship["ships"] += 1
+                ship["ms"].append((time.perf_counter() - t0) * 1e3)
+            return dec.stream(ids, max_new_tokens=max_new, kv_span=span)
+
+        def resident(i):
+            # stream() yields per-chunk token lists; the first yield is
+            # the admission token, so TPOT averages over everything after
+            toks, first, nfirst, last = [], None, 0, None
+            for chunk in start_stream(res_prompts[i], res_new):
+                now = time.perf_counter()
+                if first is None:
+                    first, nfirst = now, len(chunk)
+                last = now
+                toks.extend(chunk)
+            res_tpot[i] = (last - first) / max(1, len(toks) - nfirst)
+            outs[f"res{i}"] = toks
+
+        def incoming(i):
+            # arrive once the residents are decoding
+            time.sleep(0.3 + 0.05 * i)
+            t0 = time.perf_counter()
+            toks, first = [], None
+            for chunk in start_stream(inc_prompts[i], inc_new):
+                first = first or time.perf_counter()
+                toks.extend(chunk)
+            inc_ttft[i] = first - t0
+            outs[f"inc{i}"] = toks
+
+        threads = [
+            threading.Thread(target=resident, args=(i,)) for i in range(n_res)
+        ] + [
+            threading.Thread(target=incoming, args=(i,)) for i in range(n_inc)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        return {
+            "ttft_p50_ms": pct(inc_ttft.values(), 0.50),
+            "ttft_p99_ms": pct(inc_ttft.values(), 0.99),
+            "resident_tpot_p50_ms": pct(res_tpot.values(), 0.50),
+            "resident_tpot_p99_ms": pct(res_tpot.values(), 0.99),
+            "seconds": round(time.perf_counter() - t0, 3),
+            "tokens": sum(len(v) for v in outs.values()),
+            "decode_prefill_pieces": dec.stats["prefill_pieces"] - pieces0,
+            "outs": outs,
+        }
+
+    # -- colocated: one engine interleaves prefill + decode chunks ------- #
+    colo = mk()
+    try:
+        colocated = run(None, colo)
+    finally:
+        colo.stop()
+
+    # -- disagg: prefill pool + decode pool + per-request KV ship -------- #
+    pre, dec = mk(), mk()
+    try:
+        disagg = run(pre, dec)
+    finally:
+        pre.stop()
+        dec.stop()
+    decode_prefill_pieces = disagg["decode_prefill_pieces"]
+
+    identical = colocated.pop("outs") == disagg.pop("outs")
+    ship_ms = sorted(ship["ms"])
+    return {
+        "metric": "engine_disagg_ttft_p99_ms",
+        "value": disagg["ttft_p99_ms"],
+        "unit": "ms",
+        "vs_baseline": (
+            round(colocated["ttft_p99_ms"] / disagg["ttft_p99_ms"], 3)
+            if disagg["ttft_p99_ms"]
+            else None
+        ),
+        "detail": {
+            "residents": {"n": n_res, "prompt_tokens": 16, "max_new": res_new},
+            "incoming": {
+                "n": n_inc,
+                "prompt_tokens": [len(p) for p in inc_prompts],
+                "max_new": inc_new,
+            },
+            "model": ("1024d x 12L" if on_tpu else "tiny-cpu"),
+            "colocated": colocated,
+            "disagg": disagg,
+            "tokens_identical": identical,
+            "decode_prefill_pieces": decode_prefill_pieces,
+            "kv_ship": {
+                "ships": ship["ships"],
+                "total_bytes": ship["bytes"],
+                "bytes_per_ship": (
+                    ship["bytes"] // ship["ships"] if ship["ships"] else 0
+                ),
+                "p50_ms": (
+                    round(ship_ms[len(ship_ms) // 2], 2) if ship_ms else None
+                ),
+                "p99_ms": (
+                    round(ship_ms[min(len(ship_ms) - 1,
+                                      int(0.99 * len(ship_ms)))], 2)
+                    if ship_ms
+                    else None
+                ),
+            },
+            "baseline_is": (
+                "one colocated engine whose scheduler interleaves prefill "
+                "chunks with resident rows' decode chunks — the "
+                "interference disaggregation removes by giving prefill its "
+                "own pool and shipping the finished span"
+            ),
+        },
+    }
+
+
+# --------------------------------------------------------------------------- #
 # config 8 (beyond BASELINE): training hot-loop overlap — device prefetch +
 # async metric drain + in-graph gradient accumulation (train/prefetch.py).
 # Baseline = the same Trainer fully synchronous (prefetch_depth=0), the
@@ -1520,12 +1729,13 @@ def _probe_backend(timeout_s: float = 120.0) -> str:
 def main(argv: list[str] | None = None) -> int:
     device_benches = (
         bench_mnist, bench_resnet, bench_bert, bench_serving, bench_generate,
-        bench_engine, bench_engine_decode, bench_train_overlap,
+        bench_engine, bench_engine_decode, bench_engine_disagg,
+        bench_train_overlap,
     )
     all_benches = (
         bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving,
         bench_generate, bench_engine, bench_engine_decode,
-        bench_train_overlap,
+        bench_engine_disagg, bench_train_overlap,
     )
     # `python bench.py engine_decode [...]` runs just the named configs
     # (names = bench_* suffixes); no args runs the whole suite + headline

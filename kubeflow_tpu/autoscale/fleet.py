@@ -57,6 +57,7 @@ class ReplicaFleet:
         *,
         pool: Any = None,
         model: str | None = None,
+        role: str = "both",
         transfer_prefix_kv: bool = True,
         prefix_tokens: int = 16,
         drain_timeout_s: float = 30.0,
@@ -66,6 +67,10 @@ class ReplicaFleet:
         self.launch = launch
         self.pool = pool
         self.model = model
+        #: every replica this fleet launches joins the pool with this
+        #: disagg role ("both" | "prefill" | "decode") — a prefill pool
+        #: and a decode pool are two fleets over the same service
+        self.role = role
         self.transfer_prefix_kv = transfer_prefix_kv and model is not None
         self.prefix_tokens = prefix_tokens
         self.drain_timeout_s = drain_timeout_s
@@ -123,7 +128,9 @@ class ReplicaFleet:
                 index_urls=[r.url for r in self._replicas if r is not replica],
             )
         if self.pool is not None:
-            self.pool.add(self.service, url)  # ready → activator flush
+            # ready → activator flush (prefill-role replicas never become
+            # traffic-selectable; they only serve kv_span:prefill pulls)
+            self.pool.add(self.service, url, role=self.role)
         logger.warning(
             "fleet %s: replica #%d up at %s (%d total)",
             self.service, index, url, len(self._replicas),

@@ -192,6 +192,36 @@ def slow_decode(engine, *, delay_s: float = 0.05):
     return stop
 
 
+def drop_kv_ship(engine, *, count: int = 1):
+    """Fail the engine's next ``count`` disaggregated KV-span pulls at
+    the wire seam (``fetch_kv_span``'s ``kv_ship`` fault hook fires
+    before the HTTP POST — the prefill peer dying mid-ship). The pull's
+    fallback contract does the rest: the decode replica prefills locally
+    and the client sees identical tokens. Self-uninstalls after
+    ``count`` fires; returns ``stop()`` to remove it early."""
+    remaining = [int(count)]
+
+    def hook(eng) -> None:
+        if remaining[0] <= 0:
+            return
+        remaining[0] -= 1
+        record_injection("drop_kv_ship")
+        logger.warning(
+            "chaos: dropping KV ship (%d more to drop)", remaining[0]
+        )
+        if remaining[0] <= 0 and eng._fault_hooks.get("kv_ship") is hook:
+            eng._fault_hooks.pop("kv_ship", None)
+        raise OSError("chaos: injected KV-ship failure (peer died mid-ship)")
+
+    engine._fault_hooks["kv_ship"] = hook
+
+    def stop() -> None:
+        if engine._fault_hooks.get("kv_ship") is hook:
+            engine._fault_hooks.pop("kv_ship", None)
+
+    return stop
+
+
 # --------------------------------------------------------------------- #
 # storage / transfer faults
 # --------------------------------------------------------------------- #

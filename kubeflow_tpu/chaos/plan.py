@@ -129,6 +129,18 @@ class DropPrefixCache(Fault):
 
 
 @dataclasses.dataclass(frozen=True)
+class DropKVShip(Fault):
+    """Serving fault: fail the named model's next ``count`` cross-replica
+    KV-span pulls (disaggregated prefill→decode ships) at the wire seam —
+    the prefill peer dying mid-ship. The decode replica must fall back to
+    a LOCAL prefill with no client-visible failure: same tokens, one
+    ``kv_ship_fallbacks`` tick, zero 5xx."""
+
+    model: str = ""
+    count: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class CorruptCheckpoint(Fault):
     """Silently flip one byte in the newest checkpoint step under
     ``directory`` (or an explicit ``step``) — the bit-rot/torn-copy case
@@ -142,7 +154,8 @@ class CorruptCheckpoint(Fault):
 FAULT_KINDS = {
     c.__name__: c
     for c in (CrashWorker, PreemptWorker, WedgeWorker, DropSlice,
-              WedgeEngine, SlowDecode, DropPrefixCache, CorruptCheckpoint)
+              WedgeEngine, SlowDecode, DropPrefixCache, DropKVShip,
+              CorruptCheckpoint)
 }
 
 

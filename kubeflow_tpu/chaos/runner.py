@@ -28,6 +28,7 @@ from kubeflow_tpu.chaos import injectors
 from kubeflow_tpu.chaos.plan import (
     CorruptCheckpoint,
     CrashWorker,
+    DropKVShip,
     DropSlice,
     Fault,
     FaultPlan,
@@ -40,7 +41,7 @@ from kubeflow_tpu.chaos.plan import (
 
 #: serving fault kinds: target an LMEngine resolved by model name via the
 #: runner's ``engines`` mapping, not a training worker process
-_SERVING_FAULTS = (WedgeEngine, SlowDecode, DropPrefixCache)
+_SERVING_FAULTS = (WedgeEngine, SlowDecode, DropPrefixCache, DropKVShip)
 from kubeflow_tpu.obs import heartbeat as hb
 from kubeflow_tpu.orchestrator.spec import WorkerPhase, WorkerStatus
 
@@ -162,6 +163,8 @@ class ChaosRunner:
                 injectors.wedge_engine(engine, hold_s=fault.hold_s)
             elif isinstance(fault, DropPrefixCache):
                 injectors.drop_prefix_cache(engine)
+            elif isinstance(fault, DropKVShip):
+                injectors.drop_kv_ship(engine, count=fault.count)
             else:
                 injectors.slow_decode(engine, delay_s=fault.delay_s)
             logger.warning(

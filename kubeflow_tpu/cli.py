@@ -252,6 +252,7 @@ def _cmd_serve(args) -> int:
         http_port=args.http_port,
         grpc_port=args.grpc_port,
         default_deadline_ms=args.default_deadline_ms,
+        role=args.role,
     )
     for spec in specs:
         spec.validate()
@@ -363,6 +364,7 @@ def _cmd_gateway(args) -> int:
                     subprocess_launcher(list(auto["replicaCommand"])),
                     pool=gw.pool,
                     model=auto.get("model", svc),
+                    role=auto.get("role", "both"),
                     transfer_prefix_kv=bool(
                         auto.get("transferPrefixKV", True)
                     ),
@@ -978,6 +980,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="storage-initializer destination (default: tmpdir)")
     s.add_argument("--port-file", default=None,
                    help="write the bound HTTP port here once listening")
+    s.add_argument("--role", choices=("both", "prefill", "decode"),
+                   default="both",
+                   help="disaggregated-serving role: 'prefill' replicas "
+                        "only answer kv_span:prefill pulls, 'decode' "
+                        "replicas pull their prefill KV from the peer the "
+                        "gateway stamps (x-kft-prefill-peer)")
     s.add_argument("--default-deadline-ms", type=float, default=None,
                    help="end-to-end budget applied to requests arriving "
                         "without an x-kft-deadline-ms header (KServe "

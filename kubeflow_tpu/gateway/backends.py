@@ -126,6 +126,12 @@ class Backend:
     url: str
     service: str
     revision: str = "default"  # "default" | "canary"
+    #: disaggregated-serving role: "both" replicas take any traffic,
+    #: "decode" replicas take client traffic but skip prefill work (they
+    #: pull KV spans from a peer), "prefill" replicas NEVER receive
+    #: client requests — the gateway only hands their URL to decode
+    #: replicas via the x-kft-prefill-peer header
+    role: str = "both"  # "both" | "prefill" | "decode"
     state: str = "active"  # "active" | "draining"
     outstanding: int = 0
     probe_ok: bool = True  # optimistic until the first probe says otherwise
@@ -137,6 +143,7 @@ class Backend:
             "url": self.url,
             "service": self.service,
             "revision": self.revision,
+            "role": self.role,
             "state": self.state,
             "outstanding": self.outstanding,
             "probe_ok": self.probe_ok,
@@ -172,20 +179,29 @@ class BackendPool:
     # -- membership ------------------------------------------------------ #
 
     def add(
-        self, service: str, url: str, *, revision: str = "default"
+        self,
+        service: str,
+        url: str,
+        *,
+        revision: str = "default",
+        role: str = "both",
     ) -> Backend:
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown backend role: {role!r}")
         existing = self.find(url)
         if existing is not None:
             # re-add of a draining/known URL revives it in place
             existing.state = "active"
             existing.service = service
             existing.revision = revision
+            existing.role = role
             self._notify_ready(service)
             return existing
         b = Backend(
             url=url.rstrip("/"),
             service=service,
             revision=revision,
+            role=role,
             breaker=CircuitBreaker(self._breaker_cfg, clock=self._clock),
         )
         self._backends.setdefault(service, []).append(b)
@@ -233,14 +249,34 @@ class BackendPool:
 
     def selectable(self, service: str, revision: str | None = None) -> list[Backend]:
         """Backends eligible for new traffic (active, probe-healthy; the
-        breaker filter happens in ``pick`` so half-open trials stay single)."""
+        breaker filter happens in ``pick`` so half-open trials stay single).
+        Prefill-role backends are never traffic-eligible: they only serve
+        ``kv_span:prefill`` pulls from their decode peers."""
         return [
             b
             for b in self._backends.get(service, [])
             if b.state == "active"
             and b.probe_ok
+            and b.role != "prefill"
             and (revision is None or b.revision == revision)
         ]
+
+    def pick_prefill(self, service: str) -> Backend | None:
+        """Least-outstanding healthy prefill-pool backend for a service,
+        or None when the service runs colocated (no prefill backends —
+        the common case, and the disagg fallback when every prefill
+        replica is ejected/tripped)."""
+        cands = [
+            b
+            for b in self._backends.get(service, [])
+            if b.role == "prefill"
+            and b.state == "active"
+            and b.probe_ok
+            and b.breaker.current_state() == "closed"
+        ]
+        if not cands:
+            return None
+        return min(cands, key=lambda b: (b.outstanding, b.url))
 
     def pick(
         self, service: str, revision: str | None = None

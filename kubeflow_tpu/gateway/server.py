@@ -59,7 +59,11 @@ from kubeflow_tpu.gateway.router import (
     ServiceRoute,
     affinity_key_of,
 )
-from kubeflow_tpu.obs.headers import TENANT_HEADER, TRACE_HEADER
+from kubeflow_tpu.obs.headers import (
+    PREFILL_PEER_HEADER,
+    TENANT_HEADER,
+    TRACE_HEADER,
+)
 from kubeflow_tpu.obs.trace import TRACER, ctx_from_headers
 from kubeflow_tpu.serve.deadline import (
     DEADLINE_ABS_HEADER,
@@ -148,8 +152,9 @@ class GatewayConfig:
     retry_budget_ratio: float = 0.2
     retry_budget_floor: int = 3
     routes: list[ServiceRoute] = dataclasses.field(default_factory=list)
-    #: (service, url, revision) triples registered at startup
-    backends: list[tuple[str, str, str]] = dataclasses.field(
+    #: (service, url, revision, role) tuples registered at startup;
+    #: role is "both" | "prefill" | "decode" (disaggregated serving)
+    backends: list[tuple[str, str, str, str]] = dataclasses.field(
         default_factory=list
     )
     #: tenant → {max_rps, burst, max_in_flight}
@@ -205,10 +210,15 @@ class GatewayConfig:
             )
             for be in svc.get("backends", []):
                 if isinstance(be, str):
-                    cfg.backends.append((name, be, "default"))
+                    cfg.backends.append((name, be, "default", "both"))
                 else:
                     cfg.backends.append(
-                        (name, be["url"], be.get("revision", "default"))
+                        (
+                            name,
+                            be["url"],
+                            be.get("revision", "default"),
+                            be.get("role", "both"),
+                        )
                     )
             if "autoscaling" in svc:
                 auto = dict(svc["autoscaling"])
@@ -261,10 +271,14 @@ class InferenceGateway:
         self.table = RouteTable(salt=self.config.salt)
         for r in self.config.routes:
             self.table.upsert(r)
-        for service, url, revision in self.config.backends:
+        for entry in self.config.backends:
+            # pre-disagg configs built 3-tuples (service, url, revision);
+            # a missing role means "both"
+            service, url, revision = entry[0], entry[1], entry[2]
+            role = entry[3] if len(entry) > 3 else "both"
             if self.table.get(service) is None:
                 self.table.upsert(ServiceRoute(name=service))
-            self.pool.add(service, url, revision=revision)
+            self.pool.add(service, url, revision=revision, role=role)
         if policy is not None:
             self.policy = policy
         else:
@@ -460,6 +474,21 @@ class InferenceGateway:
         fwd.pop(TRACE_HEADER.title(), None)
         if span:
             fwd[TRACE_HEADER] = span.header()
+        # the prefill-peer header is gateway-authoritative: a client (or
+        # a compromised hop) must not be able to point a decode replica
+        # at an arbitrary URL to pull KV from
+        fwd.pop(PREFILL_PEER_HEADER, None)
+        fwd.pop(PREFILL_PEER_HEADER.title(), None)
+        if path.endswith("/generate") or path.endswith("/generate_stream"):
+            # disaggregated dispatch: hand the decode replica its prefill
+            # peer. None when the service runs colocated OR every prefill
+            # replica is unhealthy — the decode replica then prefills
+            # locally, so disagg degrades to colocated, never to an error.
+            pb = self.pool.pick_prefill(route.name)
+            if pb is not None:
+                fwd[PREFILL_PEER_HEADER] = pb.url
+                if span:
+                    span.set_attr("prefill_peer", pb.url)
         #: the end-to-end budget, anchored at edge arrival: queue time in
         #: the activator and retry rounds are charged against it. Only
         #: the WIRE header counts — an absolute stamp arriving off the

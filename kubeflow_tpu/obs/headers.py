@@ -40,6 +40,14 @@ PRIORITY_HEADER = "x-kft-priority"
 TENANT_HEADER = "x-kft-tenant"
 #: W3C traceparent-shaped trace context (obs/trace.py mints and parses)
 TRACE_HEADER = "x-kft-trace"
+#: disaggregated serving: URL of the prefill-pool replica the decode
+#: replica should pull this request's KV span from (gateway-stamped on
+#: generate dispatches when the service has prefill-role backends;
+#: stripped off the wire inbound — only the gateway may assert it)
+PREFILL_PEER_HEADER = "x-kft-prefill-peer"
+#: session identity for the host-RAM KV tier (client-set, opaque): turns
+#: of the same session swap their KV span out/in across requests
+SESSION_HEADER = "x-kft-session"
 
 __all__ = [
     "DEADLINE_HEADER",
@@ -47,4 +55,6 @@ __all__ = [
     "PRIORITY_HEADER",
     "TENANT_HEADER",
     "TRACE_HEADER",
+    "PREFILL_PEER_HEADER",
+    "SESSION_HEADER",
 ]

@@ -169,6 +169,18 @@ ENGINE_KV_QUANT_ERROR = "kft_engine_kv_quant_error"
 #: gauge — 1 while the engine's paged read path runs the Pallas kernel
 #: (LMEngineConfig paged_attn_impl="kernel"), 0 for the XLA gather
 ENGINE_PAGED_ATTN_KERNEL = "kft_engine_paged_attn_kernel"
+#: disaggregated prefill/decode (serve/engine.py prefill_span / inject):
+#: counter{model,direction} — bytes of per-request KV spans shipped over
+#: the wire (direction: export on the prefill replica, import on decode)
+ENGINE_KV_SHIP_BYTES_TOTAL = "kft_engine_kv_ship_bytes_total"
+#: histogram{model} — one KV-span ship leg end to end, milliseconds
+#: (decode-side: peer prefill RPC + decode + inject-validate)
+ENGINE_KV_SHIP_MS = "kft_engine_kv_ship_ms"
+#: host-RAM KV tier (serve/kv_tier.py): gauge{model} — encoded KV bytes
+#: resident in the bounded host pool
+ENGINE_KV_OFFLOAD_BYTES = "kft_engine_kv_offload_bytes"
+#: gauge{model} — swapped-out session rows resident in the host tier
+ENGINE_KV_OFFLOAD_RESIDENT_ROWS = "kft_engine_kv_offload_resident_rows"
 
 # -- serving SRE layer (serve/deadline.py, serve/watchdog.py) ------------ #
 

@@ -62,6 +62,12 @@ from kubeflow_tpu.models.transformer import (
     TransformerLM,
     init_kv_cache,
 )
+from kubeflow_tpu.obs import names, prom
+from kubeflow_tpu.obs.headers import (
+    PREFILL_PEER_HEADER,
+    SESSION_HEADER,
+    TRACE_HEADER,
+)
 from kubeflow_tpu.obs.trace import (
     TRACER,
     ctx_from_headers,
@@ -81,10 +87,25 @@ from kubeflow_tpu.serve.generate import (
     decode_span_kv_mask,
     sample_logits as _sample,
 )
+from kubeflow_tpu.serve.kv_codec import decode_kv_entries
+from kubeflow_tpu.serve.kv_tier import HostKVTier
 
 #: idle park bound — every waker (submit, stream-cancel, stop) sets
 #: ``_work``, so this timeout is only a belt-and-braces sweep, not a poll
 _IDLE_PARK_S = 5.0
+
+#: disaggregated-serving wire metrics: per-request KV span bytes by leg
+#: (``export`` = prefill replica serving :prefill, ``import`` = decode
+#: replica pulling) and the end-to-end latency of one ship
+KV_SHIP_BYTES = prom.REGISTRY.counter(
+    names.ENGINE_KV_SHIP_BYTES_TOTAL,
+    "bytes of per-request KV spans shipped between replicas",
+    labels=("model", "direction"),
+)
+KV_SHIP_MS = prom.REGISTRY.histogram(
+    names.ENGINE_KV_SHIP_MS,
+    "one KV-span ship leg (fetch + decode + validate), milliseconds",
+)
 
 
 @dataclass
@@ -146,6 +167,11 @@ class LMEngineConfig:
     spec_ngram: int = 3
     paged_attn_impl: str = "gather"
     kv_quant: str = "none"
+    #: host-RAM KV tier byte budget (serve/kv_tier.py): > 0 enables the
+    #: tier — sessioned rows swap their KV span out through the npz codec
+    #: on finish and back in (byte-identically) on the session's next
+    #: turn. 0 (default) disables it: no offload thread, no host pool.
+    host_kv_bytes: int = 0
 
 
 @dataclass
@@ -192,6 +218,21 @@ class _Request:
     # tenant priority (higher = shed last): under sustained overload the
     # lowest-priority queued request is evicted first
     priority: int = 0
+    # disaggregated prefill (prefill-pool side): run ONLY the prefill and
+    # hand the finished KV span back instead of activating the row —
+    # _advance_prefill's final piece fills kv_span/kv_span_meta and
+    # retires the request without ever decoding
+    want_kv_span: bool = False
+    kv_span: Any = None
+    kv_span_meta: dict | None = None
+    # disaggregated decode (decode-pool side): a peer-prefilled span
+    # (PreparedKVSpan) admitted by implant — this engine never computes a
+    # prefill chunk for the request
+    kv_inject: "PreparedKVSpan | None" = None
+    # host-RAM KV tier (serve/kv_tier.py): session identity — finished
+    # rows swap their span out under this key; the session's next turn
+    # swaps it back in
+    session: str | None = None
     # set on admission:
     row: int = -1
     gen_start: int = 0
@@ -267,6 +308,19 @@ class _Request:
                 self.model, ttft_ms=ttft_ms, tpot_ms=tpot_ms
             )
         span.end(status)
+
+
+@dataclass(frozen=True)
+class PreparedKVSpan:
+    """One shipped per-request KV span validated against a specific
+    engine (``LMEngine.prepare_kv_span``) and device-put, ready for
+    ``submit(kv_span=...)``: the per-layer tree (jnp), the ship meta
+    (``real_len`` / ``first_tok`` / ``valid``), and the ceil-16 window
+    width the tree covers."""
+
+    tree: Any
+    meta: dict
+    n16: int
 
 
 class EngineOverloaded(RuntimeError):
@@ -523,6 +577,13 @@ class LMEngine:
             # (pre-initialized: /metrics iterates from another thread)
             "deadline_expired_queued": 0, "deadline_expired_decoding": 0,
             "shed_deadline": 0, "shed_priority": 0,
+            # disaggregated prefill/decode: spans exported (prefill pool),
+            # spans injected without a local prefill (decode pool), ship
+            # bytes pulled, and ship failures degraded to local prefill
+            "kv_spans_exported": 0, "kv_injected": 0,
+            "kv_ship_bytes": 0, "kv_ship_fallbacks": 0,
+            # host-RAM KV tier: sessions swapped out on finish / back in
+            "kv_offload_out": 0, "kv_offload_in": 0,
         }
         # pipelined-decode state: the device-resident carry of per-row
         # scheduling arrays, its dirtiness (host edits pending merge), and
@@ -550,6 +611,20 @@ class LMEngine:
             # EWMA of mean-abs relative KV quantization error, measured by
             # the suffix-prefill program (kft_engine_kv_quant_error)
             self.overlap["kv_quant_error"] = 0.0
+
+        #: host-RAM KV tier (serve/kv_tier.py): finished sessioned rows
+        #: swap their KV span out through the npz codec into this bounded
+        #: host pool; the session's next turn swaps it back in via the
+        #: prefix-implant machinery. The D2H + encode runs on a dedicated
+        #: offload worker thread so a swap-out never stalls the scheduler.
+        self.host_kv_tier = (
+            HostKVTier(config.host_kv_bytes)
+            if config.host_kv_bytes > 0 else None
+        )
+        self._offload_q: "queue.Queue | None" = (
+            queue.Queue() if self.host_kv_tier is not None else None
+        )
+        self._offload_thread: threading.Thread | None = None
 
         # prefix cache (vLLM automatic-prefix-caching analog): completed
         # prompt prefills donate their KV, keyed by the prompt ids rounded
@@ -1111,6 +1186,11 @@ class LMEngine:
     # -- host scheduler ----------------------------------------------------- #
 
     def start(self) -> "LMEngine":
+        if self.host_kv_tier is not None and self._offload_thread is None:
+            self._offload_thread = threading.Thread(
+                target=self._offload_loop, name="kv-offload", daemon=True
+            )
+            self._offload_thread.start()
         self._thread = threading.Thread(
             target=self._loop, name="lm-engine", daemon=True
         )
@@ -1122,6 +1202,10 @@ class LMEngine:
         self._work.set()
         if self._thread is not None:
             self._thread.join(30)
+        if self._offload_thread is not None:
+            self._offload_q.put(None)  # drain-then-exit sentinel
+            self._offload_thread.join(10)
+            self._offload_thread = None
         # anything still queued or mid-generation must not hang its caller
         # until timeout_s — fail it with the truth now
         err = RuntimeError("LM engine stopped")
@@ -1225,7 +1309,9 @@ class LMEngine:
     def _enqueue(
         self, ids, max_new_tokens, temperature, *, live: bool,
         deadline: float | None = None, priority: int = 0,
-        trace: Any = None,
+        trace: Any = None, want_kv_span: bool = False,
+        kv_inject: PreparedKVSpan | None = None,
+        session: str | None = None,
     ) -> _Request:
         if not ids:
             raise ValueError("empty prompt")
@@ -1280,6 +1366,10 @@ class LMEngine:
             # token space is contiguous in paged mode (no bucket-padding
             # gap), so the layout IS the prompt itself
             layout = len(ids)
+        elif kv_inject is not None:
+            # an injected span occupies exactly its ceil-16 window; no
+            # prefill ever runs here, so bucket/chunk layouts don't apply
+            layout = kv_inject.n16
         elif self.prefill_chunk is not None:
             # chunked prefill frees prompts from the bucket bound: the only
             # limit is the piece layout fitting max_seq
@@ -1316,12 +1406,14 @@ class LMEngine:
                     f"request needs {need} pages; pool has "
                     f"{self.pager.num_pages - 1} — raise kv_pool_tokens"
                 )
-            if self.prefill_chunk is None:
+            if self.prefill_chunk is None and kv_inject is None:
                 self._bucket(len(ids))  # reject over-bucket prompts now
         req = _Request(
             list(ids), max_new_tokens, temperature,
             live=queue.Queue() if live else None,
             deadline=deadline, priority=priority,
+            want_kv_span=want_kv_span, kv_inject=kv_inject,
+            session=session,
         )
         if trace is not None:
             # engine-stage span under the caller's wire context (a Span or
@@ -1391,17 +1483,24 @@ class LMEngine:
         deadline: float | None = None,
         priority: int = 0,
         trace: Any = None,
+        kv_span: PreparedKVSpan | None = None,
+        session: str | None = None,
     ) -> list[int]:
         """``deadline`` (absolute ``time.monotonic()``) is the end-to-end
         budget; ``timeout_s`` is the legacy knob and becomes the deadline
         when none is given — one clock governs queue wait AND decode.
         ``trace`` (a Span or parsed TraceContext) parents the engine-stage
-        spans; None (warmup, untraced callers) records nothing."""
+        spans; None (warmup, untraced callers) records nothing.
+        ``kv_span`` (a ``prepare_kv_span`` result for these exact ids)
+        admits by implanting the peer-prefilled span — this engine never
+        computes a prefill chunk for the request. ``session`` keys the
+        host-RAM KV tier when it is enabled."""
         if deadline is None:
             deadline = time.monotonic() + timeout_s
         req = self._enqueue(
             ids, max_new_tokens, temperature, live=False,
             deadline=deadline, priority=priority, trace=trace,
+            kv_inject=kv_span, session=session,
         )
         if not req.done.wait(max(0.0, deadline - time.monotonic())):
             # hand the row back: a timed-out caller must not leave its
@@ -1424,9 +1523,12 @@ class LMEngine:
         deadline: float | None = None,
         priority: int = 0,
         trace: Any = None,
+        kv_span: PreparedKVSpan | None = None,
+        session: str | None = None,
     ):
         """Yields lists of new tokens as decode chunks complete — the
         streaming data path (KServe v2 generate_stream analog).
+        ``kv_span``/``session``: same contract as :meth:`submit`.
 
         Every wait is charged against ONE monotonic deadline: the old
         per-item ``get(timeout=timeout_s)`` granted the full budget per
@@ -1436,6 +1538,7 @@ class LMEngine:
         req = self._enqueue(
             ids, max_new_tokens, temperature, live=True,
             deadline=deadline, priority=priority, trace=trace,
+            kv_inject=kv_span, session=session,
         )
         try:
             while True:
@@ -1459,6 +1562,56 @@ class LMEngine:
             if not req.done.is_set():
                 req.cancelled.set()
                 self._work.set()
+
+    def prefill_span(
+        self,
+        ids: list[int],
+        *,
+        temperature: float = 0.0,
+        timeout_s: float = 120.0,
+        deadline: float | None = None,
+        trace: Any = None,
+    ) -> tuple[dict, dict]:
+        """The prefill-pool half of disaggregated serving: run ONLY the
+        (chunked) prefill of ``ids`` and return ``(tree, meta)`` — the
+        finished KV span as host arrays in the prefix-entry format
+        (ceil-16 window; positions past the prompt hold junk the decode
+        side masks or overwrites before ever attending) plus the meta the
+        decode replica needs (``real_len``, ``first_tok``, ``valid``).
+        The row retires the moment the span is extracted: this engine
+        never decodes the request, so ``prefill_pieces`` is the only work
+        counter a pure prefill replica ever moves."""
+        n16 = -(-len(ids) // 16) * 16
+        # the generation budget is a LAYOUT reservation only — it sizes
+        # the paged allocation so the whole ceil-16 extract window is
+        # backed by real pages; no decode chunk ever runs against it.
+        # Dense cache rows are max_seq wide regardless of bucket, so the
+        # extract window is always backed and budget 1 keeps small
+        # bucket+max_seq configs admissible
+        budget = max(1, n16 - len(ids) + 1) if self.paged else 1
+        if deadline is None:
+            deadline = time.monotonic() + timeout_s
+        req = self._enqueue(
+            list(ids), budget, temperature, live=False,
+            deadline=deadline, trace=trace, want_kv_span=True,
+        )
+        if not req.done.wait(max(0.0, deadline - time.monotonic())):
+            req.cancelled.set()
+            self._work.set()
+            DEADLINE_EXPIRED.labels(stage="wait").inc()
+            raise DeadlineExceeded("prefill-span timed out", stage="wait")
+        if req.error is not None:
+            raise req.error
+        if req.kv_span is None:
+            raise RuntimeError("prefill-span request retired before extract")
+        tree = {
+            name: {
+                which: np.asarray(arr)  # kft: noqa[jax-sync] — span-export D2H runs on the caller's HTTP-executor thread, never the scheduler loop
+                for which, arr in lc.items()
+            }
+            for name, lc in req.kv_span.items()
+        }
+        return tree, dict(req.kv_span_meta)
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -1614,8 +1767,17 @@ class LMEngine:
         region, and process the FIRST piece. Long prompts (chunked prefill)
         leave the row in 'prefilling' state — subsequent pieces interleave
         with decode chunks so admissions never stall in-flight rows."""
+        if req.kv_inject is not None:
+            self._admit_injected(req, row)
+            return
         base, rest = 0, req.ids
         hit = self._lookup_prefix(req.ids)
+        if hit is None and req.session and self.host_kv_tier is not None:
+            # host-tier swap-in: the session's previous turn parked its
+            # span here — it re-enters through the prefix-implant path
+            # (same machinery, different store) and continues
+            # byte-identically
+            hit = self._take_swapped(req)
         implanted = None
         if hit is not None:
             key, stored = hit
@@ -1702,6 +1864,91 @@ class LMEngine:
             # _advance_prefills so decode chunks run between pieces
             self._advance_prefill(row)
 
+    def _admit_injected(self, req: _Request, row: int) -> None:
+        """Admit a peer-prefilled request: implant its shipped KV span
+        and activate the row directly — the disaggregation invariant is
+        that this engine NEVER computes a prefill chunk for it (on a pure
+        decode-pool replica ``prefill_pieces`` stays zero). The span's
+        first sampled token rides the meta, so the request starts exactly
+        where the prefill replica left it: dense rows mask the
+        [real_len, n16) junk gap via ``decode_kv_mask``; paged rows
+        overwrite [real_len, ...) with real decode KV before any query
+        position reaches it."""
+        span = req.kv_inject
+        tree, meta, n16 = span.tree, span.meta, span.n16
+        if self.paged:
+            # claim pages FIRST (availability verified by _admit_all);
+            # the allocation covers len + max_new >= the implant window
+            self.pager.alloc(
+                row, self.pager.pages_for(len(req.ids) + req.max_new_tokens)
+            )
+            self._implant_paged(tree, row, n16)
+        else:
+            self.cache = self._implant(self.cache, tree, row)
+        gen_start = len(req.ids) if self.paged else n16
+        req.row, req.gen_start = row, gen_start
+        self._slots[row] = req
+        self.real_len[row] = len(req.ids)
+        if self.spec_k:
+            self.hist_host[row, :] = self.pad_id
+            self.hist_host[row, : len(req.ids)] = req.ids
+        self.gen_start[row] = gen_start
+        self.gen_count[row] = 0
+        self.budget[row] = req.max_new_tokens
+        self.temp[row] = req.temperature
+        self.stats["admitted"] += 1
+        self.stats["kv_injected"] += 1
+        self.stats["max_concurrent"] = max(
+            self.stats["max_concurrent"],
+            sum(s is not None for s in self._slots),
+        )
+        if self.paged:
+            self.stats["kv_pages_used_peak"] = max(
+                self.stats["kv_pages_used_peak"], self.pager.used_pages
+            )
+        if req.qspan is not None:
+            req.qspan.end()
+            req.qspan = None
+        if req.espan is not None:
+            req.espan.set_attr("kv_injected", True)
+        tok = int(meta["first_tok"])
+        if bool(meta["valid"]):
+            req.push([tok])
+            if self.spec_k:
+                self.hist_host[row, len(req.ids)] = tok
+        self.last_tok[row] = tok
+        finished = (not bool(meta["valid"])) or req.max_new_tokens <= 1
+        if finished:
+            self._finish(row)
+        else:
+            self.active[row] = True
+            self.gen_count[row] = 1
+            self._carry_dirty = True
+
+    def _take_swapped(self, req: _Request):
+        """Consume the host tier's stored span for the request's session
+        (when its tokens prefix the new prompt), decode it through the
+        npz codec, and return ``(key, jnp tree)`` in _lookup_prefix's
+        format — or None (miss, diverged prompt, corrupt or incompatible
+        blob: all degrade to a normal full prefill)."""
+        blob = self.host_kv_tier.take(req.session, req.ids)
+        if blob is None:
+            return None
+        try:
+            entries, _ = decode_kv_entries(blob)
+            key, tree = entries[0]
+        except Exception:  # noqa: BLE001 — a corrupt blob is a miss
+            return None
+        n16 = len(key)
+        if n16 < 16 or n16 % 16 or self._span_reject(tree, n16) is not None:
+            return None
+        jtree = {
+            name: {which: jnp.asarray(arr) for which, arr in lc.items()}
+            for name, lc in tree.items()
+        }
+        self.stats["kv_offload_in"] += 1
+        return tuple(key), jtree
+
     def _advance_prefill(self, row: int) -> None:
         """Run ONE prefill piece for a prefilling row; the final piece
         yields the first token and activates (or finishes) the request."""
@@ -1751,6 +1998,22 @@ class LMEngine:
         if self._prefix_cache is not None:
             self._store_prefix(req.ids, row)
         tok = int(tok)
+        if req.want_kv_span:
+            # disaggregated prefill: extract the finished span (ceil-16
+            # window) and retire the row WITHOUT activating — a prefill
+            # replica never decodes this request, and no token is pushed
+            # (the first sampled token travels in the meta instead, so
+            # TTFT is observed once, on the decode side)
+            n16 = -(-len(req.ids) // 16) * 16
+            req.kv_span = self._extract_prefix(row, n16)
+            req.kv_span_meta = {
+                "real_len": len(req.ids),
+                "first_tok": tok,
+                "valid": bool(valid),
+            }
+            self.stats["kv_spans_exported"] += 1
+            self._finish(row)
+            return
         if bool(valid):
             req.push([tok])
             if self.spec_k:
@@ -1779,7 +2042,18 @@ class LMEngine:
         req = self._slots[row]
         self._slots[row] = None
         self.active[row] = False
-        self._prefilling.pop(row, None)
+        was_prefilling = self._prefilling.pop(row, None) is not None
+        if (
+            req is not None
+            and self.host_kv_tier is not None
+            and req.session
+            and req.error is None
+            and not req.want_kv_span
+            and not was_prefilling  # mid-prefill rows: KV incomplete
+        ):
+            # swap-out must extract BEFORE the pages free (the block
+            # table row is still this request's)
+            self._swap_out(req, row)
         if self.paged:
             self.pager.free(row)
         # ``carry_stale=False`` is the drain's EOS/budget retirement: the
@@ -1795,6 +2069,67 @@ class LMEngine:
             # moment their submit returns (warmup does)
             self.stats["completed"] += 1
             req.finish()
+
+    def _swap_out(self, req: _Request, row: int) -> None:
+        """Queue a finished sessioned row's KV span for the host tier.
+        KV is written for the first ``real_len + emitted - 1`` context
+        positions in PAGED mode (contiguous token space); DENSE rows only
+        have contiguous real KV over the prompt (generated KV sits past
+        the bucket gap), so they store the prompt window only. The
+        extract here is device handles (async); the D2H + encode runs on
+        the offload worker thread."""
+        ctx_tokens = list(req.ids) + list(req.tokens)
+        if self.paged:
+            written = len(req.ids) + max(0, len(req.tokens) - 1)
+        else:
+            written = len(req.ids)
+        n16 = (min(written, self.max_seq) // 16) * 16
+        if n16 < 16:
+            return
+        try:
+            tree = self._extract_prefix(row, n16)
+        except Exception:  # noqa: BLE001 — swap-out is best-effort: a
+            return         # failed extract just means a re-prefill later
+        self._offload_q.put((req.session, tuple(ctx_tokens[:n16]), tree))
+
+    def _offload_loop(self) -> None:
+        """Offload worker: the swap-out D2H sync + npz encode + tier
+        insert run HERE, never on the scheduler thread — a host-tier
+        swap-out must not stall decode dispatch. Items are (session,
+        key_tokens, device_tree); a threading.Event is a flush barrier
+        (tests/drain); None exits."""
+        from kubeflow_tpu.serve.kv_codec import encode_kv_entries
+
+        while True:
+            item = self._offload_q.get()
+            if item is None:
+                return
+            if isinstance(item, threading.Event):
+                item.set()
+                continue
+            session, key, tree = item
+            try:
+                host = {
+                    name: {
+                        which: np.asarray(arr)  # kft: noqa[jax-sync] — host-tier swap-out D2H runs on the offload worker thread, never the scheduler loop
+                        for which, arr in lc.items()
+                    }
+                    for name, lc in tree.items()
+                }
+                blob = encode_kv_entries([(key, host)])
+                if self.host_kv_tier.put(session, key, blob):
+                    self.stats["kv_offload_out"] += 1
+            except Exception:  # noqa: BLE001 — swap-out is best-effort;
+                pass           # the session re-prefills on its next turn
+
+    def flush_offload(self, timeout_s: float = 10.0) -> bool:
+        """Block until every swap-out queued so far has landed in the
+        host tier (tests and drain hooks; production never waits)."""
+        if self._offload_q is None or self._offload_thread is None:
+            return True
+        done = threading.Event()
+        self._offload_q.put(done)
+        return done.wait(timeout_s)
 
     def _loop(self) -> None:
         try:
@@ -2206,17 +2541,6 @@ class LMEngine:
         not count (and are not touched — local recency wins)."""
         if self._prefix_cache is None:
             return 0
-        H, D = self.cfg.kv_heads, self.cfg.head_dim
-        layer_names = set(self.cache)
-        # mixed-quantization rejection: an int8 engine's entries carry
-        # k_scale/v_scale (and int8 codes) — a float engine must not
-        # ingest them (it would attend to raw codes), and vice versa an
-        # int8 engine cannot use float entries without a scale. The key
-        # SET is the wire-level discriminator.
-        quant = self.kv_quant == "int8"
-        want_keys = (
-            {"k", "v", "k_scale", "v_scale"} if quant else {"k", "v"}
-        )
         prepared = []
         for key, tree in entries:
             key = tuple(int(t) for t in key)
@@ -2228,22 +2552,7 @@ class LMEngine:
                 and n16 > self._prefix_cache_tokens
             ):
                 continue
-            if set(tree) != layer_names:
-                continue
-            want = (1, H, n16, D)
-            want_scale = (1, H, n16)
-            if any(set(lc) != want_keys for lc in tree.values()):
-                continue
-            if any(
-                np.shape(lc.get("k")) != want or np.shape(lc.get("v")) != want
-                for lc in tree.values()
-            ):
-                continue
-            if quant and any(
-                np.shape(lc["k_scale"]) != want_scale
-                or np.shape(lc["v_scale"]) != want_scale
-                for lc in tree.values()
-            ):
+            if self._span_reject(tree, n16) is not None:
                 continue
             prepared.append((
                 key,
@@ -2264,6 +2573,80 @@ class LMEngine:
                 imported += 1
         self.stats["prefix_imported"] += imported
         return imported
+
+    def _span_reject(self, tree, n16: int) -> str | None:
+        """Why a wire KV tree (a prefix-cache entry, a shipped
+        per-request span, or a host-tier blob — ONE validator guards
+        every plane of the codec) cannot implant into THIS engine; None
+        when it can. The key-SET check is the wire-level
+        mixed-quantization discriminator: int8 trees carry
+        ``k_scale``/``v_scale`` planes alongside the codes, float trees
+        must not — a float engine would attend to raw codes, an int8
+        engine has no scales to dequantize with."""
+        H, D = self.cfg.kv_heads, self.cfg.head_dim
+        if set(tree) != set(self.cache):
+            return "layer names differ from this engine's model"
+        quant = self.kv_quant == "int8"
+        want_keys = (
+            {"k", "v", "k_scale", "v_scale"} if quant else {"k", "v"}
+        )
+        want = (1, H, n16, D)
+        want_scale = (1, H, n16)
+        for name, lc in tree.items():
+            if set(lc) != want_keys:
+                return (
+                    f"quantization mismatch: layer {name!r} carries "
+                    f"{sorted(lc)} but this engine's kv_quant is "
+                    f"{self.kv_quant!r}"
+                )
+            if np.shape(lc["k"]) != want or np.shape(lc["v"]) != want:
+                return (
+                    f"KV shape {np.shape(lc['k'])} != {want} "
+                    "(kv_heads / head_dim / window mismatch)"
+                )
+            if quant and (
+                np.shape(lc["k_scale"]) != want_scale
+                or np.shape(lc["v_scale"]) != want_scale
+            ):
+                return f"scale plane shape != {want_scale}"
+        return None
+
+    def prepare_kv_span(self, ids, tree, meta) -> PreparedKVSpan:
+        """Validate a shipped per-request KV span against THIS engine and
+        device-put it for ``submit(kv_span=...)`` injection. Raises
+        ValueError on ANY layout or quantization mismatch — callers
+        (engine.fetch_kv_span) treat that as a failed ship and fall back
+        to a local prefill, so a misconfigured pool pairing degrades to
+        colocated behavior instead of corrupting a row."""
+        try:
+            real_len = int(meta["real_len"])
+            first_tok = int(meta["first_tok"])
+            valid = bool(meta["valid"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"kv span meta malformed: {e}") from None
+        if real_len != len(ids):
+            raise ValueError(
+                f"kv span covers a {real_len}-token prompt; this request "
+                f"has {len(ids)} tokens"
+            )
+        n16 = -(-real_len // 16) * 16
+        if n16 + 1 > self.max_seq:
+            raise ValueError(
+                f"kv span window {n16} + 1 exceeds engine max_seq "
+                f"{self.max_seq}"
+            )
+        reason = self._span_reject(tree, n16)
+        if reason is not None:
+            raise ValueError(f"kv span rejected: {reason}")
+        jtree = {
+            name: {which: jnp.asarray(arr) for which, arr in lc.items()}
+            for name, lc in tree.items()
+        }
+        return PreparedKVSpan(
+            jtree,
+            {"real_len": real_len, "first_tok": first_tok, "valid": valid},
+            n16,
+        )
 
     def drop_prefix_cache(self) -> int:
         """Wipe every stored prefix entry (the chaos ``DropPrefixCache``
@@ -2310,6 +2693,90 @@ class _AdmittedStream:
             self._release_once()
 
 
+def _header_get(headers, name: str):
+    """Read one x-kft-* header from a dict/CIMultiDict (deadline.py
+    idiom: probe the exact lowercase name and its .title() spelling
+    instead of lowercasing a copy per request)."""
+    if not headers:
+        return None
+    val = headers.get(name)
+    if val is None:
+        val = headers.get(name.title())
+    return val
+
+
+def fetch_kv_span(
+    engine: LMEngine,
+    peer: str,
+    model_name: str,
+    ids,
+    temperature: float,
+    *,
+    trace: Any = None,
+    timeout_s: float = 30.0,
+) -> PreparedKVSpan | None:
+    """Decode-replica side of a disaggregated dispatch: pull the finished
+    KV span for ``ids`` from the prefill-pool replica at ``peer`` (the
+    gateway-stamped ``x-kft-prefill-peer`` URL) and validate it against
+    ``engine``. Returns a :class:`PreparedKVSpan` ready for
+    ``submit(kv_span=...)`` — or None on ANY failure (peer down or
+    killed mid-ship, bad payload, layout/quantization mismatch, chaos
+    ``DropKVShip``), in which case the caller runs a normal local
+    prefill: disaggregation is an optimization, never a correctness
+    dependency, and a broken ship leg must stay invisible to the client.
+
+    Runs on an HTTP-executor / SSE-pump thread (blocking urllib), never
+    the scheduler loop. The ``kv.ship`` span bridges the prefill and
+    decode legs of ONE trace id: its context is forwarded to the peer,
+    so the prefill replica's engine span lands under the same trace the
+    gateway minted."""
+    import json as _json
+    import urllib.request
+
+    t0 = time.monotonic()
+    span = TRACER.span("kv.ship", parent=trace)
+    if span:
+        span.set_attr("peer", peer)
+        span.set_attr("model", model_name)
+        span.set_attr("prompt_tokens", len(ids))
+    try:
+        hook = engine._fault_hooks.get("kv_ship")
+        if hook is not None:
+            hook(engine)  # chaos seam: DropKVShip raises here
+        body = _json.dumps(
+            {"ids": [int(t) for t in ids], "temperature": float(temperature)}
+        ).encode()
+        hdrs = {"Content-Type": "application/json"}
+        if span:
+            hdrs[TRACE_HEADER] = span.header()
+        req = urllib.request.Request(
+            f"{peer.rstrip('/')}/v2/models/{model_name}/kv_span:prefill",
+            data=body, headers=hdrs, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            blob = resp.read()
+        entries, meta = decode_kv_entries(blob)
+        if not entries or meta is None:
+            raise ValueError("span payload missing entries or meta")
+        prepared = engine.prepare_kv_span(ids, entries[0][1], meta)
+        n = len(blob)
+        KV_SHIP_BYTES.labels(model=model_name, direction="import").inc(n)
+        KV_SHIP_MS.observe((time.monotonic() - t0) * 1e3)
+        engine.stats["kv_ship_bytes"] += n
+        if span:
+            span.set_attr("bytes", n)
+            span.end()
+        return prepared
+    except Exception as e:  # noqa: BLE001 — EVERY ship failure (network,
+        # payload, validation, chaos) degrades to a local prefill on the
+        # decode replica; the client never sees it
+        engine.stats["kv_ship_fallbacks"] += 1
+        if span:
+            span.set_attr("error", f"{type(e).__name__}: {e}")
+            span.end("error")
+        return None
+
+
 class LMEngineModel(LMRuntimeModel):
     """Engine-backed serving model: the ``causal-lm`` runtime's data path
     (tokenizer, preprocess, postprocess) with continuous batching
@@ -2323,13 +2790,15 @@ class LMEngineModel(LMRuntimeModel):
         prefill_chunk=None, mesh=None, rules=None,
         kv_pool_tokens=None, page_size=64, pipeline_depth=1,
         spec_draft_tokens=0, spec_ngram=3,
-        paged_attn_impl="gather", kv_quant="none", watchdog=True,
+        paged_attn_impl="gather", kv_quant="none", host_kv_bytes=0,
+        watchdog=True,
         watchdog_interval_s=0.5, watchdog_wedge_factor=8.0,
         watchdog_min_wedge_s=30.0, **kwargs,
     ):
         super().__init__(name, storage_path, **kwargs)
         self._engine_max_batch = max_batch
         self._engine_chunk = chunk_steps
+        self._engine_host_kv_bytes = host_kv_bytes
         self._engine_prefix_entries = prefix_cache_entries
         self._engine_prefix_tokens = prefix_cache_tokens
         self._engine_mesh = mesh
@@ -2396,6 +2865,7 @@ class LMEngineModel(LMRuntimeModel):
             spec_ngram=self._engine_spec_ngram,
             paged_attn_impl=self._engine_paged_attn_impl,
             kv_quant=self._engine_kv_quant,
+            host_kv_bytes=self._engine_host_kv_bytes,
         )
         # engine spans and TTFT/TPOT histograms label by serving model
         eng.model_name = self.name
@@ -2549,10 +3019,29 @@ class LMEngineModel(LMRuntimeModel):
         for key in eng.overlap:
             eng.overlap[key] = 0 if key == "carry_uploads" else 0.0
 
+    def _pull_kv_span(self, row, peer, trace, deadline):
+        """Fetch + validate this row's KV span from its prefill peer
+        (None ⇒ no disaggregation, or any ship failure → local prefill).
+        Runs on the executor / SSE-pump thread — never the event loop."""
+        if not peer:
+            return None
+        eng = self.engine
+        if eng is None:
+            return None
+        timeout_s = 30.0
+        if deadline is not None:
+            timeout_s = max(0.1, min(timeout_s, deadline - time.monotonic()))
+        return fetch_kv_span(
+            eng, peer, self.name, row["ids"], row["temperature"],
+            trace=trace, timeout_s=timeout_s,
+        )
+
     def _submit_row(
         self, row, deadline: float | None = None, priority: int = 0,
-        trace: Any = None,
+        trace: Any = None, peer: str | None = None,
+        session: str | None = None,
     ) -> dict:
+        kv_span = self._pull_kv_span(row, peer, trace, deadline)
         toks = self.engine.submit(
             row["ids"],
             max_new_tokens=self.max_new_tokens,
@@ -2560,6 +3049,8 @@ class LMEngineModel(LMRuntimeModel):
             deadline=deadline,
             priority=priority,
             trace=trace,
+            kv_span=kv_span,
+            session=session,
         )
         return {"token_ids": toks}
 
@@ -2591,10 +3082,12 @@ class LMEngineModel(LMRuntimeModel):
         deadline = deadline_from_headers(headers)
         priority = priority_from_headers(headers)
         ctx = ctx_from_headers(headers)
+        peer = _header_get(headers, PREFILL_PEER_HEADER)
+        session = _header_get(headers, SESSION_HEADER)
         self._admit(len(rows))
         futs = [
             self._executor.submit(
-                self._submit_row, r, deadline, priority, ctx
+                self._submit_row, r, deadline, priority, ctx, peer, session
             )
             for r in rows
         ]
@@ -2613,16 +3106,26 @@ class LMEngineModel(LMRuntimeModel):
         deadline = deadline_from_headers(headers)
         priority = priority_from_headers(headers)
         ctx = ctx_from_headers(headers)
+        peer = _header_get(headers, PREFILL_PEER_HEADER)
+        session = _header_get(headers, SESSION_HEADER)
         self._admit(1)
-        gen = self.engine.stream(
-            row["ids"],
-            max_new_tokens=self.max_new_tokens,
-            temperature=row["temperature"],
-            deadline=deadline,
-            priority=priority,
-            trace=ctx,
-        )
-        return _AdmittedStream(gen, lambda: self._release(1))
+
+        def run():
+            # the peer pull (blocking HTTP) runs HERE — at first next(),
+            # on the SSE pump thread — never on the event loop
+            kv_span = self._pull_kv_span(row, peer, ctx, deadline)
+            yield from self.engine.stream(
+                row["ids"],
+                max_new_tokens=self.max_new_tokens,
+                temperature=row["temperature"],
+                deadline=deadline,
+                priority=priority,
+                trace=ctx,
+                kv_span=kv_span,
+                session=session,
+            )
+
+        return _AdmittedStream(run(), lambda: self._release(1))
 
     async def __call__(self, payload, headers=None):
         import asyncio
@@ -2631,6 +3134,8 @@ class LMEngineModel(LMRuntimeModel):
         deadline = deadline_from_headers(headers)
         priority = priority_from_headers(headers)
         ctx = ctx_from_headers(headers)
+        peer = _header_get(headers, PREFILL_PEER_HEADER)
+        session = _header_get(headers, SESSION_HEADER)
         self._admit(len(rows))
         try:
             loop = asyncio.get_running_loop()
@@ -2641,7 +3146,7 @@ class LMEngineModel(LMRuntimeModel):
                 *[
                     loop.run_in_executor(
                         self._executor, self._submit_row, r, deadline,
-                        priority, ctx,
+                        priority, ctx, peer, session,
                     )
                     for r in rows
                 ],

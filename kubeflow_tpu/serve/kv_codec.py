@@ -1,0 +1,77 @@
+"""npz wire codec for KV-cache trees — one format, three transfer planes.
+
+PR 11 introduced this encoding for cross-replica *prefix-cache* transfer
+(serve/server.py ``prefix_cache:export``/``:pull``); disaggregated serving
+generalizes the same bytes to arbitrary **per-request KV spans** (a prefill
+replica ships one request's finished KV to its decode replica) and the
+**host-RAM KV tier** (serve/kv_tier.py swaps idle sessions' spans out of
+HBM and back byte-identically). Keeping one codec means int8-quantized
+entries (codes + ``k_scale``/``v_scale`` planes) ride every plane
+unchanged, and the layout/quantization validation the import side performs
+is the same check everywhere.
+
+Wire layout (``np.savez``, ``allow_pickle=False`` on decode — the payload
+crosses a network boundary and must stay plain arrays):
+
+- ``"{i}|{layer}|{which}"`` — entry ``i``'s per-layer arrays (``which`` ∈
+  ``k``/``v``/``k_scale``/``v_scale``);
+- ``__keys__`` — JSON bytes: the token-id key per entry, so the payload is
+  self-describing (no side-channel headers to drift);
+- ``__meta__`` — OPTIONAL JSON bytes: span metadata (``real_len``,
+  ``first_tok``, ``valid`` for a per-request ship; absent for plain
+  prefix-cache transfers, so pre-existing peers decode unchanged).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any
+
+import numpy as np
+
+
+def encode_kv_entries(entries, meta: dict | None = None) -> bytes:
+    """``[(key, {layer: {"k": np, "v": np, ...}}), ...]`` (+ optional JSON
+    ``meta``) → one npz blob. Generic over the per-layer dict, so int8
+    entries' scale planes ride the same format."""
+    arrays: dict[str, Any] = {}
+    keys = []
+    for i, (key, tree) in enumerate(entries):
+        keys.append([int(t) for t in key])
+        for layer, kv in tree.items():
+            for which, arr in kv.items():
+                arrays[f"{i}|{layer}|{which}"] = arr
+    arrays["__keys__"] = np.frombuffer(
+        json.dumps(keys).encode(), dtype=np.uint8
+    )
+    if meta is not None:
+        arrays["__meta__"] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8
+        )
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_kv_entries(blob: bytes):
+    """Inverse of :func:`encode_kv_entries` → ``(entries, meta)`` where
+    ``meta`` is None for payloads encoded without one."""
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        keys = json.loads(bytes(z["__keys__"]).decode())
+        meta = (
+            json.loads(bytes(z["__meta__"]).decode())
+            if "__meta__" in z.files
+            else None
+        )
+        entries = []
+        for i, key in enumerate(keys):
+            tree: dict[str, dict[str, Any]] = {}
+            prefix = f"{i}|"
+            for name in z.files:
+                if not name.startswith(prefix):
+                    continue
+                _, layer, which = name.split("|", 2)
+                tree.setdefault(layer, {})[which] = z[name]
+            entries.append((tuple(int(t) for t in key), tree))
+    return entries, meta

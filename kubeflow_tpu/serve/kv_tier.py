@@ -1,0 +1,99 @@
+"""Host-RAM KV tier: the Mooncake-style layer below HBM.
+
+HBM bounds how many sessions can stay *resident*; it should not bound how
+many can stay *warm*. When a sessioned request finishes (or is preempted),
+the engine extracts the row's KV span, encodes it through the same
+int8-aware npz codec that ships spans between replicas
+(serve/kv_codec.py), and parks the bytes here — a bounded, LRU-evicted
+host pool keyed by session id. On the session's next turn, admission finds
+the stored span, verifies the stored tokens are a prefix of the new
+prompt, and implants it back into HBM byte-identically: the continuation
+decodes exactly as if the row had never left the device.
+
+Design constraints baked in:
+
+- **Encoded bytes, not arrays**: entries are the npz blob itself, so the
+  pool's byte budget is the honest host-RAM cost (int8 spans are half the
+  bf16 bytes — the codec's win carries straight into tier capacity) and a
+  swap-in exercises the identical decode path a cross-replica ship does.
+- **Thread-safe, clock-free**: ``put``/``take`` run from the engine's
+  offload worker and scheduler threads; eviction is LRU by access order,
+  never wall-clock (the monotonic-clock lint scope covers this module).
+- **Swap-in consumes the entry** (``take``, not ``get``): the implanted
+  row is now the live copy, and a stale host copy must never resurrect
+  after further decode extends the session.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class HostKVTier:
+    """Bounded host-RAM pool of encoded KV spans, keyed by session id.
+
+    ``max_bytes`` caps the sum of stored blob sizes; inserting past it
+    LRU-evicts (least recently stored/probed first). One entry per
+    session: a newer turn's span replaces the older one in place.
+    """
+
+    def __init__(self, max_bytes: int):
+        if max_bytes <= 0:
+            raise ValueError(f"max_bytes must be > 0; got {max_bytes}")
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        #: session → (tokens_tuple, blob); OrderedDict order = LRU→MRU
+        self._entries: "OrderedDict[str, tuple[tuple, bytes]]" = OrderedDict()
+        self._bytes = 0
+        self.stats = {"puts": 0, "hits": 0, "misses": 0, "evictions": 0}
+
+    def put(self, session: str, tokens, blob: bytes) -> bool:
+        """Store ``blob`` (an encoded KV span whose entry key is
+        ``tokens``) for ``session``. A blob alone larger than the whole
+        pool is refused (never evict everything for one row). Returns
+        True when stored."""
+        if len(blob) > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(session, None)
+            if old is not None:
+                self._bytes -= len(old[1])
+            self._entries[session] = (tuple(int(t) for t in tokens), blob)
+            self._bytes += len(blob)
+            self.stats["puts"] += 1
+            while self._bytes > self.max_bytes:
+                _, (_, old_blob) = self._entries.popitem(last=False)
+                self._bytes -= len(old_blob)
+                self.stats["evictions"] += 1
+        return True
+
+    def take(self, session: str, prompt_ids) -> bytes | None:
+        """Consume the stored span for ``session`` IF its tokens are a
+        proper prefix of ``prompt_ids`` (at least one token must remain
+        to prefill — same rule as the prefix cache). A session whose new
+        prompt diverged from the stored context drops the entry: its KV
+        can never be valid again."""
+        with self._lock:
+            entry = self._entries.get(session)
+            if entry is None:
+                self.stats["misses"] += 1
+                return None
+            tokens, blob = entry
+            n = len(tokens)
+            if n >= len(prompt_ids) or tuple(
+                int(t) for t in prompt_ids[:n]
+            ) != tokens:
+                del self._entries[session]
+                self._bytes -= len(blob)
+                self.stats["misses"] += 1
+                return None
+            del self._entries[session]
+            self._bytes -= len(blob)
+            self.stats["hits"] += 1
+            return blob
+
+    def resident(self) -> dict:
+        """Live occupancy for /metrics (kft_engine_kv_offload_*)."""
+        with self._lock:
+            return {"bytes": self._bytes, "rows": len(self._entries)}

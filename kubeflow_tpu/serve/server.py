@@ -141,6 +141,14 @@ ENGINE_SPEC_ACCEPTANCE = prom.REGISTRY.gauge(
     names.ENGINE_SPEC_ACCEPTANCE,
     "EWMA accepted/proposed draft ratio", ("model",),
 )
+ENGINE_KV_OFFLOAD_BYTES = prom.REGISTRY.gauge(
+    names.ENGINE_KV_OFFLOAD_BYTES,
+    "encoded KV bytes resident in the host-RAM tier", ("model",),
+)
+ENGINE_KV_OFFLOAD_ROWS = prom.REGISTRY.gauge(
+    names.ENGINE_KV_OFFLOAD_RESIDENT_ROWS,
+    "swapped-out session rows resident in the host-RAM tier", ("model",),
+)
 
 
 def _engine_collector(name: str, model):
@@ -169,6 +177,11 @@ def _engine_collector(name: str, model):
         ENGINE_SPEC_ACCEPTANCE.labels(model=name).set(
             eng.overlap["spec_acceptance"]
         )
+        tier = getattr(eng, "host_kv_tier", None)
+        if tier is not None:
+            res = tier.resident()
+            ENGINE_KV_OFFLOAD_BYTES.labels(model=name).set(res["bytes"])
+            ENGINE_KV_OFFLOAD_ROWS.labels(model=name).set(res["rows"])
 
     return collect
 
@@ -177,52 +190,21 @@ def _engine_collector(name: str, model):
 
 
 def encode_prefix_entries(entries) -> bytes:
-    """``[(key, {layer: {"k": np, "v": np, ...}}), ...]`` → one npz blob.
-    Generic over the per-layer dict, so int8 entries' ``k_scale``/
-    ``v_scale`` arrays ride the same wire format (the receiving engine's
-    import validation keys off the key set). The key list rides inside as
-    JSON bytes so the payload is self-describing (no side-channel headers
-    to drift)."""
-    import io
-    import json
+    """Back-compat name for :func:`kv_codec.encode_kv_entries` — the
+    codec moved to serve/kv_codec.py when disaggregated serving
+    generalized it from prefix-cache entries to arbitrary per-request
+    KV spans and host-tier blobs."""
+    from kubeflow_tpu.serve.kv_codec import encode_kv_entries
 
-    import numpy as np
-
-    arrays: dict[str, Any] = {}
-    keys = []
-    for i, (key, tree) in enumerate(entries):
-        keys.append([int(t) for t in key])
-        for layer, kv in tree.items():
-            for which, arr in kv.items():
-                arrays[f"{i}|{layer}|{which}"] = arr
-    arrays["__keys__"] = np.frombuffer(
-        json.dumps(keys).encode(), dtype=np.uint8
-    )
-    buf = io.BytesIO()
-    np.savez(buf, **arrays)
-    return buf.getvalue()
+    return encode_kv_entries(entries)
 
 
 def decode_prefix_entries(blob: bytes):
-    """Inverse of :func:`encode_prefix_entries`. ``allow_pickle=False``:
-    the payload crosses a network boundary and must stay plain arrays."""
-    import io
-    import json
+    """Inverse of :func:`encode_prefix_entries` (kv_codec wrapper;
+    drops the optional span meta — prefix transfers never carry one)."""
+    from kubeflow_tpu.serve.kv_codec import decode_kv_entries
 
-    import numpy as np
-
-    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
-        keys = json.loads(bytes(z["__keys__"]).decode())
-        entries = []
-        for i, key in enumerate(keys):
-            tree: dict[str, dict[str, Any]] = {}
-            prefix = f"{i}|"
-            for name in z.files:
-                if not name.startswith(prefix):
-                    continue
-                _, layer, which = name.split("|", 2)
-                tree.setdefault(layer, {})[which] = z[name]
-            entries.append((tuple(int(t) for t in key), tree))
+    entries, _ = decode_kv_entries(blob)
     return entries
 
 
@@ -458,7 +440,20 @@ class ModelServer:
         batcher: BatcherConfig | None = None,
         drain_grace_s: float = 10.0,
         default_deadline_ms: float | None = None,
+        role: str = "both",
     ):
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode'; got {role!r}"
+            )
+        #: disaggregated-serving pool role (``kft serve --role``): a
+        #: ``prefill`` replica serves kv_span:prefill and is excluded
+        #: from gateway data-path selection; a ``decode`` replica pulls
+        #: spans from the gateway-stamped prefill peer instead of
+        #: prefilling locally; ``both`` (default) is classic colocated
+        #: serving. Advertised in /v2/health/ready so fleets are
+        #: inspectable.
+        self.role = role
         self.http_port = http_port
         self.grpc_port = grpc_port
         #: graceful-drain budget: on stop, readiness flips to 503 first
@@ -533,6 +528,13 @@ class ModelServer:
         )
         app.router.add_post(
             "/v2/models/{name}/prefix_cache:pull", self._prefix_pull
+        )
+        # disaggregated serving (gateway/router.py dispatch): a prefill
+        # replica runs ONLY the prefill of one request and returns the
+        # finished KV span + meta — the per-request generalization of
+        # the prefix transfer above, through the same npz codec
+        app.router.add_post(
+            "/v2/models/{name}/kv_span:prefill", self._kv_span_prefill
         )
         # InferenceGraph routing plane ([kserve] cmd/router analog)
         app.router.add_get(
@@ -802,6 +804,60 @@ class ModelServer:
         )
         return web.json_response({"imported": imported, "peer": peer})
 
+    async def _kv_span_prefill(self, req: web.Request) -> web.Response:
+        """Disaggregated serving, prefill-pool side: chunk-prefill
+        ``ids`` on this replica's engine and stream the finished KV span
+        back through the npz codec (``__meta__`` carries real_len /
+        first_tok / valid). The caller is a decode replica's
+        ``fetch_kv_span``; the ``x-kft-trace`` context it forwards
+        parents this engine's spans under the SAME trace id, so one
+        trace shows gateway → kv.ship → both engine legs."""
+        name = req.match_info["name"]
+        model = self.dataplane.get(name)
+        eng = getattr(model, "engine", None)
+        if eng is None or not hasattr(eng, "prefill_span"):
+            raise web.HTTPNotImplemented(
+                reason=f"model '{name}' has no engine to prefill spans"
+            )
+        try:
+            body = await req.json()
+            ids = [int(t) for t in body["ids"]]
+            temperature = float(body.get("temperature", 0.0))
+            if not ids:
+                raise ValueError("empty ids")
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        ctx = ctx_from_headers(dict(req.headers))
+        deadline = deadline_from_headers(dict(req.headers))
+        loop = asyncio.get_running_loop()
+
+        def run() -> bytes:
+            from kubeflow_tpu.serve.engine import KV_SHIP_BYTES
+            from kubeflow_tpu.serve.kv_codec import encode_kv_entries
+
+            tree, meta = eng.prefill_span(
+                ids, temperature=temperature, deadline=deadline, trace=ctx
+            )
+            blob = encode_kv_entries([(tuple(ids), tree)], meta)
+            KV_SHIP_BYTES.labels(model=name, direction="export").inc(
+                len(blob)
+            )
+            return blob
+
+        try:
+            # prefill + D2H + npz packing leave the event loop
+            blob = await loop.run_in_executor(None, run)
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        except Exception as e:
+            shed = _shed_response(e)
+            if shed is None:
+                raise
+            raise shed
+        return web.Response(
+            body=blob, content_type="application/octet-stream"
+        )
+
     async def _v1_status(self, req: web.Request) -> web.Response:
         m = self.dataplane.get(req.match_info["name"])
         return web.json_response({"name": m.name, "ready": m.ready})
@@ -845,10 +901,11 @@ class ModelServer:
             # routing here, while in-flight (and straggler) requests still
             # complete during the grace window
             return web.json_response(
-                {"ready": False, "draining": True}, status=503
+                {"ready": False, "draining": True, "role": self.role},
+                status=503,
             )
         ready = all(self.dataplane.get(n).ready for n in self.dataplane.list_models())
-        return web.json_response({"ready": ready})
+        return web.json_response({"ready": ready, "role": self.role})
 
     async def _v2_meta(self, req: web.Request) -> web.Response:
         m = self.dataplane.get(req.match_info["name"])

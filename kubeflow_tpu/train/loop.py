@@ -196,7 +196,21 @@ class Trainer:
                 out_shardings = jax.tree_util.tree_map(
                     lambda s: NamedSharding(self.mesh, s), specs
                 )
-            state = jax.jit(mk, out_shardings=out_shardings)(rng)
+            # SPMD determinism contract (SURVEY.md §5.2): the same seed
+            # must yield the same params on EVERY mesh layout. The legacy
+            # threefry lowering is not sharding-invariant — jitted init
+            # with sharded out_shardings on a hybrid (data x fsdp) mesh
+            # draws different values than the replicated/pure layouts; the
+            # partitionable lowering derives each element's bits from its
+            # global index alone. Scoped to THIS trace/compile (restored
+            # after) so the process-wide PRNG stream is untouched for
+            # everything else running in-process.
+            prev = jax.config.jax_threefry_partitionable
+            jax.config.update("jax_threefry_partitionable", True)
+            try:
+                state = jax.jit(mk, out_shardings=out_shardings)(rng)
+            finally:
+                jax.config.update("jax_threefry_partitionable", prev)
         self._state_sharding = jax.tree_util.tree_map(lambda x: x.sharding, state)
         return state
 

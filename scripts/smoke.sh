@@ -885,4 +885,120 @@ async def main():
 asyncio.run(main())
 EOF
 
+echo "== disaggregated serving: prefill pool -> KV ship -> decode pool, zero decode-side prefill =="
+python - <<'EOF'
+import asyncio, json, urllib.request
+
+import jax, jax.numpy as jnp
+
+from kubeflow_tpu.gateway.router import ServiceRoute
+from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from kubeflow_tpu.serve.engine import LMEngineModel
+from kubeflow_tpu.serve.model import BucketSpec
+from kubeflow_tpu.serve.server import ModelServer
+
+cfg = TransformerConfig(vocab_size=89, d_model=32, n_layers=2, n_heads=4,
+                        d_ff=64, causal=True, max_seq_len=256,
+                        attn_impl="reference", dtype=jnp.float32)
+tlm = TransformerLM(cfg)
+params = tlm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def replica():
+    m = LMEngineModel(
+        "m", None, config=cfg, max_batch=4, chunk_steps=2,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+        max_new_tokens=6, eos_id=1,
+    )
+    m.load()
+    m._params = jax.device_put(params)
+    m.engine.stop()
+    m.engine = m._make_engine().start()
+    return m
+
+
+async def main():
+    m_pre, m_dec = replica(), replica()
+    ms_pre = ModelServer([m_pre], http_port=0, role="prefill")
+    ms_dec = ModelServer([m_dec], http_port=0, role="decode")
+    await ms_pre.start_async()
+    await ms_dec.start_async()
+
+    def port_of(ms):
+        (site,) = ms._runner.sites
+        return site._server.sockets[0].getsockname()[1]
+
+    pp, pd = port_of(ms_pre), port_of(ms_dec)
+    gw = InferenceGateway(GatewayConfig(
+        probe_interval_s=0.25,
+        routes=[ServiceRoute(name="m")],
+        backends=[("m", f"http://127.0.0.1:{pp}", "default", "prefill"),
+                  ("m", f"http://127.0.0.1:{pd}", "default", "decode")],
+    ), http_port=0)
+    await gw.start_async()
+    loop = asyncio.get_running_loop()
+    prompts = [[3 + i, 9, 11, 5, 7, 2 + i, 13, 8] for i in range(3)]
+
+    def generate(url, ids):
+        req = urllib.request.Request(
+            url, data=json.dumps({"input_ids": ids}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=180) as r:
+            return json.loads(r.read().decode())
+
+    def metric(port, name):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            for ln in r.read().decode().splitlines():
+                if ln.startswith(name + "{") or ln.startswith(name + " "):
+                    return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    try:
+        # the prefill-role replica is NOT traffic-selectable: the gateway
+        # must route every client request to the decode backend
+        state = gw.state_view()
+        roles = {b["url"]: b["role"] for b in state["services"][0]["backends"]}
+        assert set(roles.values()) == {"prefill", "decode"}, roles
+        via_gw = [
+            await loop.run_in_executor(
+                None, generate, f"http://127.0.0.1:{gw.http_port}"
+                f"/v2/models/m/generate", p)
+            for p in prompts
+        ]
+        # colocated reference: the same prompts straight at the prefill
+        # replica (a full server; role only gates gateway selection)
+        direct = [
+            await loop.run_in_executor(
+                None, generate, f"http://127.0.0.1:{pp}/v2/models/m/generate",
+                p)
+            for p in prompts
+        ]
+        assert via_gw == direct, (via_gw, direct)
+
+        # the acceptance criterion, metric-asserted off the decode
+        # replica: every span was injected, ZERO prefill chunks executed
+        # (metric() blocks, and the servers live on THIS loop: executor)
+        async def g(port, name):
+            return await loop.run_in_executor(None, metric, port, name)
+        assert await g(pd, "kubeflow_tpu_engine_prefill_pieces") == 0
+        assert await g(pd, "kubeflow_tpu_engine_kv_injected") == 3
+        assert await g(pd, "kubeflow_tpu_engine_kv_ship_bytes") > 0
+        assert await g(pd, "kubeflow_tpu_engine_kv_ship_fallbacks") == 0
+        assert await g(pp, "kubeflow_tpu_engine_kv_spans_exported") == 3
+        ship = await g(pd, "kubeflow_tpu_engine_kv_ship_bytes")
+        print(f"disagg OK: 3 generates via gateway == colocated tokens, "
+              f"decode prefill_pieces=0, kv_injected=3, "
+              f"ship_bytes={ship:.0f}")
+    finally:
+        await gw.stop_async()
+        m_pre.unload()
+        m_dec.unload()
+        await ms_pre.stop_async()
+        await ms_dec.stop_async()
+
+asyncio.run(main())
+EOF
+
 echo "smoke OK"

@@ -941,6 +941,7 @@ def test_gateway_config_from_manifest_and_cli_rejects_garbage(tmp_path):
                 "backends": [
                     "http://127.0.0.1:9001",
                     {"url": "http://127.0.0.1:9002", "revision": "canary"},
+                    {"url": "http://127.0.0.1:9003", "role": "prefill"},
                 ],
             }],
             "policy": {"tenants": {"team-a": {"maxRps": 5, "burst": 10,
@@ -953,8 +954,9 @@ def test_gateway_config_from_manifest_and_cli_rejects_garbage(tmp_path):
     (route,) = cfg.routes
     assert route.affinity == "prefix" and route.hedge_ms == 15.0
     assert cfg.backends == [
-        ("lm", "http://127.0.0.1:9001", "default"),
-        ("lm", "http://127.0.0.1:9002", "canary"),
+        ("lm", "http://127.0.0.1:9001", "default", "both"),
+        ("lm", "http://127.0.0.1:9002", "canary", "both"),
+        ("lm", "http://127.0.0.1:9003", "default", "prefill"),
     ]
     assert cfg.tenants["team-a"]["max_in_flight"] == 3
     with pytest.raises(ValueError):
