@@ -1630,6 +1630,115 @@ def bench_engine_disagg() -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# config 7b (beyond BASELINE): mid-stream failover resume overhead — the
+# engine-side cost of continuing a committed stream on a fresh replica
+# (suffix-prefill of prompt+committed) vs starting the same stream cold.
+# Baseline = the uninterrupted request's TTFT on the same engine.
+# --------------------------------------------------------------------------- #
+
+
+def bench_engine_resume() -> dict:
+    """TTFR (time to first RESUMED token) of a mid-stream-failover
+    admission vs the uninterrupted stream's TTFT, on one warm engine.
+
+    The resumed admission prefills prompt+committed as one suffix and
+    emits only tokens past the prefix — the gateway's failover path pays
+    exactly this on the surviving replica, so TTFR/TTFT is the client's
+    observed mid-stream hiccup relative to a cold start. Also asserts the
+    spliced token stream equals the uninterrupted one."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+    from kubeflow_tpu.serve.engine import LMEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = TransformerConfig(
+        vocab_size=32768,
+        d_model=1024 if on_tpu else 128,
+        n_layers=12 if on_tpu else 2,
+        n_heads=16 if on_tpu else 4,
+        d_ff=4096 if on_tpu else 256,
+        causal=True,
+        attn_impl="flash" if on_tpu else "reference",
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    n_req, prompt_len, max_new = 8, 48, 32
+    prompts = [
+        [int(t) for t in rng.integers(2, cfg.vocab_size, size=prompt_len)]
+        for _ in range(n_req)
+    ]
+    eng = LMEngine(
+        model, cfg, params, max_batch=4, max_seq=256, chunk_steps=8,
+        prefill_buckets=(64, 128), eos_id=-1,
+    ).start()
+
+    def first_token_latency(ids, resume_tokens=None):
+        toks = []
+        t0 = time.perf_counter()
+        ttfr = None
+        for chunk in eng.stream(
+            ids, max_new_tokens=max_new, resume_tokens=resume_tokens
+        ):
+            if ttfr is None:
+                ttfr = time.perf_counter() - t0
+            toks.extend(chunk)
+        return ttfr, toks
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))] * 1e3, 2)
+
+    try:
+        # warm both prefill buckets through their compiles
+        first_token_latency(prompts[0])
+        first_token_latency(prompts[0], resume_tokens=[5] * (max_new // 2))
+        ttft, ttfr = [], []
+        identical = True
+        for ids in prompts:
+            t_cold, full = first_token_latency(ids)
+            ttft.append(t_cold)
+            cut = len(full) // 2
+            t_res, rest = first_token_latency(ids, resume_tokens=full[:cut])
+            ttfr.append(t_res)
+            identical = identical and (full[:cut] + rest == full)
+    finally:
+        eng.stop()
+
+    p50_resume, p50_cold = pct(ttfr, 0.50), pct(ttft, 0.50)
+    return {
+        "metric": "engine_resume_ttfr_p50_ms",
+        "value": p50_resume,
+        "unit": "ms",
+        "vs_baseline": (
+            round(p50_cold / p50_resume, 3) if p50_resume else None
+        ),
+        "detail": {
+            "requests": n_req,
+            "prompt_tokens": prompt_len,
+            "max_new": max_new,
+            "model": ("1024d x 12L" if on_tpu else "tiny-cpu"),
+            "uninterrupted_ttft_p50_ms": p50_cold,
+            "uninterrupted_ttft_p99_ms": pct(ttft, 0.99),
+            "resumed_ttfr_p50_ms": p50_resume,
+            "resumed_ttfr_p99_ms": pct(ttfr, 0.99),
+            "tokens_identical": identical,
+            "baseline_is": (
+                "the same request admitted cold on the same warm engine — "
+                "TTFR/TTFT is the relative cost of the failover suffix "
+                "prefill (prompt+committed) vs the original prompt prefill"
+            ),
+        },
+    }
+
+
+# --------------------------------------------------------------------------- #
 # config 8 (beyond BASELINE): training hot-loop overlap — device prefetch +
 # async metric drain + in-graph gradient accumulation (train/prefetch.py).
 # Baseline = the same Trainer fully synchronous (prefetch_depth=0), the
@@ -1730,12 +1839,12 @@ def main(argv: list[str] | None = None) -> int:
     device_benches = (
         bench_mnist, bench_resnet, bench_bert, bench_serving, bench_generate,
         bench_engine, bench_engine_decode, bench_engine_disagg,
-        bench_train_overlap,
+        bench_engine_resume, bench_train_overlap,
     )
     all_benches = (
         bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving,
         bench_generate, bench_engine, bench_engine_decode,
-        bench_engine_disagg, bench_train_overlap,
+        bench_engine_disagg, bench_engine_resume, bench_train_overlap,
     )
     # `python bench.py engine_decode [...]` runs just the named configs
     # (names = bench_* suffixes); no args runs the whole suite + headline

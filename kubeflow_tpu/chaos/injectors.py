@@ -192,6 +192,52 @@ def slow_decode(engine, *, delay_s: float = 0.05):
     return stop
 
 
+def kill_mid_stream(engine, *, pid: int | None = None, after_tokens: int = 1,
+                    action=None):
+    """Hard-kill the replica hosting ``engine`` the moment any resident
+    request has emitted at least ``after_tokens`` tokens — a decode death
+    with tokens already committed to a client's stream, the worst case
+    for streaming (a pre-stream death just retries; a mid-stream one used
+    to tear the client's SSE parser). The gateway's failover path must
+    re-dispatch the stream to a peer with the committed prefix and splice
+    the continuation invisibly.
+
+    ``action`` overrides the kill for in-process harnesses (SIGKILLing
+    the default ``pid`` — this process — would take the test down with
+    the replica); it receives the engine and typically closes the
+    replica's server socket or raises the watchdog poison. The hook is
+    one-shot and self-uninstalls before acting, so a restarted engine
+    decodes normally. Returns ``stop()`` to disarm early."""
+
+    def hook(eng) -> None:
+        if not any(
+            req is not None and len(req.tokens) >= after_tokens
+            for req in eng._slots
+        ):
+            return
+        if eng._fault_hooks.get("pre_chunk") is hook:
+            eng._fault_hooks.pop("pre_chunk", None)
+        record_injection("kill_mid_stream")
+        logger.warning(
+            "chaos: killing replica mid-stream (>= %d tokens emitted)",
+            after_tokens,
+        )
+        if action is not None:
+            action(eng)
+            return
+        import signal
+
+        os.kill(pid if pid is not None else os.getpid(), signal.SIGKILL)
+
+    engine._fault_hooks["pre_chunk"] = hook
+
+    def stop() -> None:
+        if engine._fault_hooks.get("pre_chunk") is hook:
+            engine._fault_hooks.pop("pre_chunk", None)
+
+    return stop
+
+
 def drop_kv_ship(engine, *, count: int = 1):
     """Fail the engine's next ``count`` disaggregated KV-span pulls at
     the wire seam (``fetch_kv_span``'s ``kv_ship`` fault hook fires

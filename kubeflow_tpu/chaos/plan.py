@@ -141,6 +141,24 @@ class DropKVShip(Fault):
 
 
 @dataclasses.dataclass(frozen=True)
+class KillMidStream(Fault):
+    """Serving fault: hard-kill the named model's serving replica the
+    moment a streaming request has emitted at least ``after_tokens``
+    tokens — the worst-case decode death (tokens are already committed to
+    the client's socket). The recovery path under test is the gateway's
+    mid-stream failover: it re-dispatches the stream to a healthy peer
+    carrying the committed token prefix (``x-kft-resume-tokens``) and the
+    client sees one unbroken, byte-identical stream
+    (``kft_gateway_stream_resumes_total{outcome="ok"}``). ``pid=None``
+    kills the process hosting the engine (in-process harnesses pass an
+    action override to the injector instead)."""
+
+    model: str = ""
+    pid: int | None = None
+    after_tokens: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class CorruptCheckpoint(Fault):
     """Silently flip one byte in the newest checkpoint step under
     ``directory`` (or an explicit ``step``) — the bit-rot/torn-copy case
@@ -155,7 +173,7 @@ FAULT_KINDS = {
     c.__name__: c
     for c in (CrashWorker, PreemptWorker, WedgeWorker, DropSlice,
               WedgeEngine, SlowDecode, DropPrefixCache, DropKVShip,
-              CorruptCheckpoint)
+              KillMidStream, CorruptCheckpoint)
 }
 
 

@@ -32,6 +32,7 @@ from kubeflow_tpu.chaos.plan import (
     DropSlice,
     Fault,
     FaultPlan,
+    KillMidStream,
     PreemptWorker,
     DropPrefixCache,
     SlowDecode,
@@ -41,7 +42,8 @@ from kubeflow_tpu.chaos.plan import (
 
 #: serving fault kinds: target an LMEngine resolved by model name via the
 #: runner's ``engines`` mapping, not a training worker process
-_SERVING_FAULTS = (WedgeEngine, SlowDecode, DropPrefixCache, DropKVShip)
+_SERVING_FAULTS = (WedgeEngine, SlowDecode, DropPrefixCache, DropKVShip,
+                   KillMidStream)
 from kubeflow_tpu.obs import heartbeat as hb
 from kubeflow_tpu.orchestrator.spec import WorkerPhase, WorkerStatus
 
@@ -165,6 +167,10 @@ class ChaosRunner:
                 injectors.drop_prefix_cache(engine)
             elif isinstance(fault, DropKVShip):
                 injectors.drop_kv_ship(engine, count=fault.count)
+            elif isinstance(fault, KillMidStream):
+                injectors.kill_mid_stream(
+                    engine, pid=fault.pid, after_tokens=fault.after_tokens
+                )
             else:
                 injectors.slow_decode(engine, delay_s=fault.delay_s)
             logger.warning(

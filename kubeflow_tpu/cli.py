@@ -325,11 +325,12 @@ def _cmd_gateway(args) -> int:
         print(f"kft gateway: invalid manifest: {e}", file=sys.stderr)
         return 2
     gw = InferenceGateway(config, http_port=args.http_port)
+    resume = "on" if config.stream_resume else "off"
     for r in gw.table.routes():
         urls = [b.url for b in gw.pool.backends_of(r.name)]
         print(
             f"service/{r.name}: canary={r.canary_percent}% "
-            f"affinity={r.affinity} backends={urls}"
+            f"affinity={r.affinity} stream_resume={resume} backends={urls}"
         )
 
     async def main() -> None:

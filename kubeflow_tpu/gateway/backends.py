@@ -279,11 +279,18 @@ class BackendPool:
         return min(cands, key=lambda b: (b.outstanding, b.url))
 
     def pick(
-        self, service: str, revision: str | None = None
+        self, service: str, revision: str | None = None,
+        *, exclude: Backend | None = None,
     ) -> Backend | None:
         """Least-outstanding-requests among breaker-closed backends;
-        falls back to granting one half-open trial when nothing is closed."""
+        falls back to granting one half-open trial when nothing is closed.
+        ``exclude`` drops one backend from consideration when siblings
+        exist (mid-stream failover must prefer a peer over the replica
+        that just died, but a lone backend is still better than nothing —
+        the watchdog may already be restarting its engine)."""
         base = self.selectable(service, revision)
+        if exclude is not None and any(b is not exclude for b in base):
+            base = [b for b in base if b is not exclude]
         closed = [b for b in base if b.breaker.current_state() == "closed"]
         if closed:
             low = min(b.outstanding for b in closed)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hashlib
 import json
 import time
 import uuid
@@ -61,6 +62,8 @@ from kubeflow_tpu.gateway.router import (
 )
 from kubeflow_tpu.obs.headers import (
     PREFILL_PEER_HEADER,
+    RESUME_TOKENS_HEADER,
+    SEED_HEADER,
     TENANT_HEADER,
     TRACE_HEADER,
 )
@@ -101,6 +104,12 @@ AFFINITY_ROUTED = prom.REGISTRY.counter(
     names.GATEWAY_AFFINITY_ROUTED_TOTAL,
     "requests routed by prefix/session affinity",
     ("service",),
+)
+STREAM_RESUMES = prom.REGISTRY.counter(
+    names.GATEWAY_STREAM_RESUMES_TOTAL,
+    "mid-stream failovers: SSE streams re-dispatched with a committed-"
+    "token resume prefix, by outcome",
+    ("service", "outcome"),
 )
 
 #: hop-by-hop headers never forwarded either direction
@@ -151,6 +160,10 @@ class GatewayConfig:
     connect_timeout_s: float = 5.0
     retry_budget_ratio: float = 0.2
     retry_budget_floor: int = 3
+    #: mid-stream failover: re-dispatch a dying SSE stream to a healthy
+    #: peer with the committed-token prefix instead of surfacing a
+    #: terminal error frame (bounded by maxAttempts + the retry budget)
+    stream_resume: bool = True
     routes: list[ServiceRoute] = dataclasses.field(default_factory=list)
     #: (service, url, revision, role) tuples registered at startup;
     #: role is "both" | "prefill" | "decode" (disaggregated serving)
@@ -187,6 +200,7 @@ class GatewayConfig:
             ("connectTimeoutS", "connect_timeout_s"),
             ("retryBudgetRatio", "retry_budget_ratio"),
             ("retryBudgetFloor", "retry_budget_floor"),
+            ("streamResume", "stream_resume"),
         ):
             if yaml_key in spec:
                 setattr(cfg, attr, type(getattr(cfg, attr))(spec[yaml_key]))
@@ -479,6 +493,11 @@ class InferenceGateway:
         # at an arbitrary URL to pull KV from
         fwd.pop(PREFILL_PEER_HEADER, None)
         fwd.pop(PREFILL_PEER_HEADER.title(), None)
+        # the resume header is gateway-authoritative: only the gateway may
+        # assert a committed-token prefix (a client asserting one would
+        # splice arbitrary tokens into its own billed budget)
+        fwd.pop(RESUME_TOKENS_HEADER, None)
+        fwd.pop(RESUME_TOKENS_HEADER.title(), None)
         if path.endswith("/generate") or path.endswith("/generate_stream"):
             # disaggregated dispatch: hand the decode replica its prefill
             # peer. None when the service runs colocated OR every prefill
@@ -489,6 +508,26 @@ class InferenceGateway:
                 fwd[PREFILL_PEER_HEADER] = pb.url
                 if span:
                     span.set_attr("prefill_peer", pb.url)
+            # sampling seed, stamped deterministically from the request id
+            # (client-supplied seeds are honored): every attempt — first
+            # dispatch, retry, or mid-stream resume — carries the SAME
+            # seed, so a temperature>0 stream resumed on another replica
+            # draws the identical sampling stream
+            seed = None
+            raw_seed = request.headers.get(SEED_HEADER) or (
+                request.headers.get(SEED_HEADER.title())
+            )
+            if raw_seed is not None:
+                try:
+                    seed = int(raw_seed) & 0x7FFFFFFF
+                except ValueError:
+                    seed = None
+            if seed is None:
+                seed = int.from_bytes(
+                    hashlib.sha256(req_id.encode()).digest()[:4], "big"
+                ) & 0x7FFFFFFF
+            fwd.pop(SEED_HEADER.title(), None)
+            fwd[SEED_HEADER] = str(seed)
         #: the end-to-end budget, anchored at edge arrival: queue time in
         #: the activator and retry rounds are charged against it. Only
         #: the WIRE header counts — an absolute stamp arriving off the
@@ -576,10 +615,12 @@ class InferenceGateway:
                 if is_stream:
                     # connect-level stream failures retry like any other
                     # attempt (no response bytes have committed yet);
-                    # mid-stream failures are terminal inside _proxy_stream
+                    # mid-stream failures resume inside _proxy_stream —
+                    # re-dispatched with the committed-token prefix,
+                    # charged against the same retry budget
                     return await self._proxy_stream(
                         request, route, backend, path, fwd, body,
-                        parent=span,
+                        parent=span, budget=budget, deadline=deadline,
                     )
                 return await self._attempt(
                     route, backend, request.method, path, fwd, body,
@@ -821,16 +862,105 @@ class InferenceGateway:
             body=payload, status=status, headers={"Content-Type": ctype}
         )
 
-    # -- SSE passthrough ------------------------------------------------- #
+    # -- SSE passthrough + mid-stream failover ---------------------------- #
+
+    @staticmethod
+    def _sse_payload(frame: bytes) -> dict | None:
+        """The ``data:``-JSON payload of one whole SSE frame, or None for
+        anything else (comments, other event types, unparseable JSON —
+        all forwarded verbatim, never interpreted)."""
+        if not frame.startswith(b"data:"):
+            return None
+        try:
+            payload = json.loads(frame[5:].strip())
+        except ValueError:
+            return None
+        return payload if isinstance(payload, dict) else None
+
+    async def _pump_sse(
+        self, upstream, resp, committed: list[int], *, rewrite: bool
+    ) -> tuple[str, str | None]:
+        """Forward one upstream's SSE stream to the client in WHOLE
+        frames, tracking the generated-token prefix in ``committed``.
+        Frame alignment is a correctness property on its own: the old
+        raw-chunk passthrough could commit a torn half-frame to the
+        client when the backend died mid-write, poisoning the client's
+        SSE parser for every later frame.
+
+        Returns ``("done", None)`` after a terminal frame reached the
+        client, or ``("died", reason)`` on mid-stream death — socket
+        error, EOF without a terminal frame (a SIGKILLed replica's
+        socket often closes cleanly), or the ModelServer's ``resumable``
+        error frame (watchdog restart poison). ``rewrite`` fixes up the
+        terminal done-frame's ``n_tokens`` after a resume (the final
+        backend only counts its own segment); un-resumed streams are
+        byte-identical passthrough."""
+        import aiohttp
+
+        buf = b""
+        try:
+            async for chunk in upstream.content.iter_any():
+                buf += chunk
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    payload = self._sse_payload(frame)
+                    if payload is None:
+                        await resp.write(frame + b"\n\n")
+                        continue
+                    if payload.get("resumable"):
+                        # suppressed: the generation is continuable — the
+                        # caller re-dispatches with the committed prefix
+                        return "died", str(
+                            payload.get("error", "resumable upstream error")
+                        )
+                    if "token_ids" in payload:
+                        committed.extend(
+                            int(t) for t in payload["token_ids"]
+                        )
+                        await resp.write(frame + b"\n\n")
+                        continue
+                    if payload.get("done") and rewrite:
+                        payload["n_tokens"] = len(committed)
+                        await resp.write(
+                            f"data: {json.dumps(payload)}\n\n".encode()
+                        )
+                        return "done", None
+                    # terminal done/error frames (and anything else)
+                    # forward verbatim; a non-resumable error frame is
+                    # the backend's own verdict on the request
+                    await resp.write(frame + b"\n\n")
+                    if payload.get("done") or "error" in payload:
+                        return "done", None
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            return "died", str(e) or type(e).__name__
+        # a torn trailing half-frame in buf is DROPPED, never written —
+        # the resumed segment re-emits those tokens in a whole frame
+        return "died", "upstream EOF before terminal frame"
 
     async def _proxy_stream(
         self, request, route: ServiceRoute, backend: Backend, path, fwd,
-        body, *, parent=None,
+        body, *, parent=None, budget: RetryBudget | None = None,
+        deadline: float | None = None,
     ):
-        """Stream upstream SSE bytes to the client verbatim. A backend
-        that dies mid-stream yields one clean terminal error frame; a
-        client that disconnects tears down the upstream connection, which
-        the ModelServer observes and cancels the engine row."""
+        """Frame-aligned SSE proxy with transparent mid-stream failover.
+
+        Upstream bytes are parsed into whole ``data:`` frames and the
+        generated-token prefix the client has seen is tracked per stream.
+        When the upstream dies mid-stream, the gateway re-dispatches the
+        request to a healthy peer carrying the committed token ids
+        (``x-kft-resume-tokens``) — the sampling seed was already stamped
+        on the shared dispatch headers — and splices the continuation, so
+        the client sees ONE unbroken stream. The resumed replica admits
+        prompt+committed as a suffix-prefill (or a KV-span hit) and emits
+        only tokens past the prefix; a ``stream.resume`` span lands under
+        the original trace id next to the failed proxy span.
+
+        Resumes are bounded by the route's ``max_attempts`` and spend the
+        SAME retry budget as pre-stream retries; exhaustion (or no
+        healthy peer) falls back to the pre-failover contract — one clean
+        terminal error frame. A client disconnect at any point tears down
+        the CURRENT upstream, first or resumed, so no engine row is
+        orphaned on either replica."""
         import aiohttp
         from aiohttp import web
 
@@ -839,8 +969,9 @@ class InferenceGateway:
             span.set_attr("backend", backend.url)
             span.set_attr("revision", backend.revision)
             span.set_attr("stream", True)
-            fwd = dict(fwd)
-            fwd[TRACE_HEADER] = span.header()
+        hdrs = dict(fwd)
+        if span:
+            hdrs[TRACE_HEADER] = span.header()
         self.pool.acquire(backend)
         upstream = None
         try:
@@ -848,7 +979,7 @@ class InferenceGateway:
                 upstream = await self._session.post(
                     backend.url + path,
                     data=body,
-                    headers=fwd,
+                    headers=hdrs,
                     timeout=aiohttp.ClientTimeout(
                         total=None,
                         sock_connect=self.config.connect_timeout_s,
@@ -859,6 +990,8 @@ class InferenceGateway:
                 if span:
                     span.set_attr("error", str(e) or type(e).__name__)
                     span.end("error")
+                # nothing has committed to the client: _routed's retry
+                # loop re-dispatches like any failed attempt
                 raise _UpstreamError(backend, e) from e
             if upstream.status != 200:
                 # pre-stream refusal (429 overload, 400, 501, deadline
@@ -878,13 +1011,13 @@ class InferenceGateway:
                     ok=shed_503
                     or upstream.status not in _BACKEND_FAILURE_STATUSES,
                 )
-                hdrs = {
+                out_hdrs = {
                     "Content-Type": upstream.headers.get(
                         "Content-Type", "application/json"
                     )
                 }
                 if "Retry-After" in upstream.headers:
-                    hdrs["Retry-After"] = upstream.headers["Retry-After"]
+                    out_hdrs["Retry-After"] = upstream.headers["Retry-After"]
                 if span:
                     span.set_attr("status", upstream.status)
                     span.end(
@@ -897,7 +1030,7 @@ class InferenceGateway:
                         )
                     )
                 return web.Response(
-                    body=payload, status=upstream.status, headers=hdrs
+                    body=payload, status=upstream.status, headers=out_hdrs
                 )
             resp = web.StreamResponse(
                 headers={
@@ -906,29 +1039,155 @@ class InferenceGateway:
                 }
             )
             await resp.prepare(request)
-            try:
-                async for chunk in upstream.content.iter_any():
-                    await resp.write(chunk)
-                self.pool.record(backend, ok=True)
-            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                # backend died mid-stream: a clean terminal frame, not a
-                # torn socket — the client's SSE parser sees one error event
+            committed: list[int] = []
+            resumes = 0
+            while True:
+                outcome, err = await self._pump_sse(
+                    upstream, resp, committed, rewrite=resumes > 0
+                )
+                if outcome == "done":
+                    self.pool.record(backend, ok=True)
+                    if span:
+                        span.set_attr("tokens", len(committed))
+                        span.end()
+                        span = None
+                    if resumes:
+                        STREAM_RESUMES.labels(
+                            service=route.name, outcome="ok"
+                        ).inc()
+                    break
+                # mid-stream death: the committed prefix is intact
+                # (frame-aligned writes) — try to continue elsewhere
                 self.pool.record(backend, ok=False)
                 if span:
-                    span.event("mid_stream_failure", error=str(e) or type(e).__name__)
+                    span.event("mid_stream_failure", error=err)
                     span.end("error")
+                    span = None
+                upstream.close()
+                upstream = None
+                self.pool.release(backend)
+                dead, backend = backend, None
+                while True:  # resume-dispatch rounds, bounded below
+                    fail_reason = None
+                    if not self.config.stream_resume:
+                        fail_reason = "disabled"
+                    elif resumes + 1 >= route.max_attempts or not (
+                        budget is None or budget.try_spend()
+                    ):
+                        fail_reason = "budget_exhausted"
+                    elif deadline is not None and (
+                        deadline - time.monotonic() <= 0
+                    ):
+                        fail_reason = "failed"
+                    if fail_reason is None:
+                        # prefer any peer over the replica that just
+                        # died (pick falls back to it when it is the
+                        # only one — the watchdog may be restarting it)
+                        nxt = self.pool.pick(
+                            route.name, None, exclude=dead
+                        )
+                        if nxt is None:
+                            fail_reason = "no_backend"
+                    if fail_reason is not None:
+                        break
+                    resumes += 1
+                    RETRIES.labels(service=route.name).inc()
+                    span = (
+                        TRACER.span("stream.resume", parent=parent)
+                        if parent
+                        else None
+                    )
+                    if span:
+                        span.set_attr("backend", nxt.url)
+                        span.set_attr("revision", nxt.revision)
+                        span.set_attr("stream", True)
+                        span.set_attr("resume", resumes)
+                        span.set_attr("committed_tokens", len(committed))
+                    hdrs = dict(fwd)
+                    if span:
+                        hdrs[TRACE_HEADER] = span.header()
+                    if committed:
+                        hdrs[RESUME_TOKENS_HEADER] = ",".join(
+                            str(t) for t in committed
+                        )
+                    if deadline is not None:
+                        hdrs[DEADLINE_HEADER.title()] = str(
+                            max(1, int(
+                                (deadline - time.monotonic()) * 1e3
+                            ))
+                        )
+                        hdrs.pop(DEADLINE_HEADER, None)
+                    self.pool.acquire(nxt)
+                    backend = nxt
+                    try:
+                        upstream = await self._session.post(
+                            nxt.url + path,
+                            data=body,
+                            headers=hdrs,
+                            timeout=aiohttp.ClientTimeout(
+                                total=None,
+                                sock_connect=self.config.connect_timeout_s,
+                            ),
+                        )
+                        if upstream.status != 200:
+                            status = upstream.status
+                            upstream.close()
+                            upstream = None
+                            raise RuntimeError(
+                                f"resume dispatch returned {status}"
+                            )
+                    except (
+                        aiohttp.ClientError,
+                        asyncio.TimeoutError,
+                        OSError,
+                        RuntimeError,
+                    ) as e:
+                        self.pool.record(nxt, ok=False)
+                        if span:
+                            span.set_attr(
+                                "error", str(e) or type(e).__name__
+                            )
+                            span.end("error")
+                            span = None
+                        if upstream is not None:
+                            upstream.close()
+                            upstream = None
+                        self.pool.release(nxt)
+                        backend = None
+                        STREAM_RESUMES.labels(
+                            service=route.name, outcome="failed"
+                        ).inc()
+                        # another dispatch round: it charges the budget
+                        # again, so the whole affair stays bounded by
+                        # max_attempts even if every peer refuses
+                        continue
+                    break  # resumed upstream is live
+                if upstream is not None:
+                    continue  # next _pump_sse round on the new upstream
+                # no resume possible: the pre-failover contract — one
+                # clean terminal error frame, never a torn socket
+                if fail_reason != "disabled":
+                    STREAM_RESUMES.labels(
+                        service=route.name, outcome=fail_reason
+                    ).inc()
                 frame = json.dumps(
-                    {"error": f"upstream failed mid-stream: {e}"}
+                    {"error": f"upstream failed mid-stream: {err}"}
                 )
                 await resp.write(f"data: {frame}\n\n".encode())
+                break
             await resp.write_eof()
             if span:
                 span.end()
             return resp
         finally:
+            # satellite fix: after a resume there are N upstreams across
+            # the stream's life — tear down the CURRENT one (a client
+            # disconnect during failover must cancel the RESUMED
+            # replica's engine row, not the dead replica's)
             if upstream is not None:
                 upstream.close()  # hard close → backend sees the disconnect
-            self.pool.release(backend)
+            if backend is not None:
+                self.pool.release(backend)
             if span is not None and span.end_time is None:
                 # a client disconnect raised out of resp.write above:
                 # close the span instead of leaking the trace open

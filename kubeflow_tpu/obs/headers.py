@@ -48,6 +48,18 @@ PREFILL_PEER_HEADER = "x-kft-prefill-peer"
 #: session identity for the host-RAM KV tier (client-set, opaque): turns
 #: of the same session swap their KV span out/in across requests
 SESSION_HEADER = "x-kft-session"
+#: mid-stream failover resume contract: comma-separated generated token
+#: ids the gateway already committed to the client. The engine admits
+#: prompt+committed as a suffix-prefill (or a KV-span/host-tier hit) and
+#: emits only tokens past the committed prefix. Gateway-stamped on resume
+#: dispatches; stripped off the wire inbound — only the gateway may
+#: assert a committed prefix
+RESUME_TOKENS_HEADER = "x-kft-resume-tokens"
+#: per-request sampling seed (gateway-stamped, deterministic from the
+#: request id): temperature>0 rows draw token t from
+#: fold_in(PRNGKey(seed), absolute_position_of_t), so a resumed stream on
+#: ANY replica continues the exact sampling stream the dead replica began
+SEED_HEADER = "x-kft-seed"
 
 __all__ = [
     "DEADLINE_HEADER",
@@ -57,4 +69,6 @@ __all__ = [
     "TRACE_HEADER",
     "PREFILL_PEER_HEADER",
     "SESSION_HEADER",
+    "RESUME_TOKENS_HEADER",
+    "SEED_HEADER",
 ]

@@ -92,6 +92,11 @@ GATEWAY_ACTIVATIONS_TOTAL = "kft_gateway_activations_total"
 GATEWAY_ACTIVATOR_QUEUE_DEPTH = "kft_gateway_activator_queue_depth"
 #: gauge{service} — 1 while a cold-episode scale-up kick is outstanding
 GATEWAY_ACTIVATOR_COLD_EPISODE = "kft_gateway_activator_cold_episode"
+#: counter{service,outcome} — mid-stream failovers: a decode stream whose
+#: upstream died after bytes were committed, re-dispatched to a healthy
+#: peer with the x-kft-resume-tokens contract (outcome: ok /
+#: budget_exhausted / no_backend / failed)
+GATEWAY_STREAM_RESUMES_TOTAL = "kft_gateway_stream_resumes_total"
 
 # -- serving autoscaler (autoscale/) ------------------------------------ #
 
@@ -195,6 +200,9 @@ ENGINE_ADMISSION_SHED_TOTAL = "kft_engine_admission_shed_total"
 ENGINE_WATCHDOG_TRIPS_TOTAL = "kft_engine_watchdog_trips_total"
 #: counter{model} — supervised engine restarts (device state rebuilt)
 ENGINE_RESTARTS_TOTAL = "kft_engine_restarts_total"
+#: counter{model} — requests admitted with a committed-token resume
+#: prefix (the engine half of the gateway's mid-stream failover)
+ENGINE_RESUME_ADMITS_TOTAL = "kft_engine_resume_admits_total"
 
 # -- request tracing (obs/trace.py) -------------------------------------- #
 

@@ -49,6 +49,8 @@ from kubeflow_tpu.obs.headers import (  # noqa: F401 — re-export
     DEADLINE_ABS_HEADER,
     DEADLINE_HEADER,
     PRIORITY_HEADER,
+    RESUME_TOKENS_HEADER,
+    SEED_HEADER,
 )
 
 DEADLINE_EXPIRED = prom.REGISTRY.counter(
@@ -153,3 +155,39 @@ def remaining_s(
     if deadline is None:
         return None
     return deadline - clock()
+
+
+def resume_from_headers(
+    headers: Mapping[str, str] | None,
+) -> list[int] | None:
+    """Committed token ids carried by the mid-stream failover resume
+    header (``x-kft-resume-tokens``, comma-separated ints), or None when
+    this is not a resume dispatch. A malformed header is rejected as
+    no-resume rather than half-parsed: resuming from a wrong committed
+    prefix would splice garbage into the client's stream."""
+    if not headers:
+        return None
+    raw = headers.get(RESUME_TOKENS_HEADER) or headers.get(
+        RESUME_TOKENS_HEADER.title()
+    )
+    if raw is None:
+        return None
+    try:
+        toks = [int(t) for t in raw.split(",") if t.strip()]
+    except ValueError:
+        return None
+    return toks or None
+
+
+def seed_from_headers(headers: Mapping[str, str] | None) -> int | None:
+    """Per-request sampling seed (``x-kft-seed``), or None when unseeded
+    (legacy engine-RNG sampling)."""
+    if not headers:
+        return None
+    raw = headers.get(SEED_HEADER) or headers.get(SEED_HEADER.title())
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None

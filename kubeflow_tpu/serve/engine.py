@@ -80,6 +80,8 @@ from kubeflow_tpu.serve.deadline import (
     DeadlineExceeded,
     deadline_from_headers,
     priority_from_headers,
+    resume_from_headers,
+    seed_from_headers,
 )
 from kubeflow_tpu.serve.generate import (
     LMRuntimeModel,
@@ -105,6 +107,13 @@ KV_SHIP_BYTES = prom.REGISTRY.counter(
 KV_SHIP_MS = prom.REGISTRY.histogram(
     names.ENGINE_KV_SHIP_MS,
     "one KV-span ship leg (fetch + decode + validate), milliseconds",
+)
+#: mid-stream failover (gateway resume contract): requests admitted with
+#: a committed-token prefix — the engine half of a transparent migration
+RESUME_ADMITS = prom.REGISTRY.counter(
+    names.ENGINE_RESUME_ADMITS_TOTAL,
+    "requests admitted with a committed-token resume prefix",
+    labels=("model",),
 )
 
 
@@ -233,6 +242,13 @@ class _Request:
     # rows swap their span out under this key; the session's next turn
     # swaps it back in
     session: str | None = None
+    # mid-stream failover resume: how many committed tokens the prompt
+    # was extended by (``ids`` already contains them — stats/trace only),
+    # and the per-request sampling seed (None = legacy engine-RNG draws;
+    # seeded rows draw token t from fold_in(PRNGKey(seed), position_of_t)
+    # so a resumed stream continues the exact sampling stream)
+    resume: int = 0
+    seed: int | None = None
     # set on admission:
     row: int = -1
     gen_start: int = 0
@@ -531,6 +547,15 @@ class LMEngine:
         self.last_tok = np.zeros((max_batch,), np.int32)
         self.active = np.zeros((max_batch,), bool)
         self.temp = np.zeros((max_batch,), np.float32)
+        #: per-row sampling seed (-1 = unseeded: legacy engine-RNG draws,
+        #: bit-identical to the pre-resume engine). Seeded rows draw
+        #: position-folded per-row keys, so their token stream is
+        #: independent of batch composition, row index and RNG history —
+        #: the property a cross-replica resume needs.
+        self.seeds = np.full((max_batch,), -1, np.int32)
+        #: host twin used to pick the static `seeded` program variant at
+        #: chunk dispatch without a device sync; refreshed per carry build
+        self._carry_seeded = False
         self._slots: list[_Request | None] = [None] * max_batch
         # speculative decoding: the host mirror of the per-row token
         # history (prompt + generated, TOKEN-POSITION indexed — identical
@@ -577,6 +602,9 @@ class LMEngine:
             # (pre-initialized: /metrics iterates from another thread)
             "deadline_expired_queued": 0, "deadline_expired_decoding": 0,
             "shed_deadline": 0, "shed_priority": 0,
+            # mid-stream failover: requests admitted with a committed-
+            # token resume prefix (kft_engine_resume_admits_total)
+            "resume_admits": 0,
             # disaggregated prefill/decode: spans exported (prefill pool),
             # spans injected without a local prefill (decode pool), ship
             # bytes pulled, and ship failures degraded to local prefill
@@ -667,14 +695,20 @@ class LMEngine:
         # result every chunk (never Orbax-restored), so donation is safe
         # and saves a (B, max_seq) copy per chunk
         chunk_donate = (0, 1) if self.spec_k else (0,)
+        # ``seeded`` is a STATIC specialization knob: the seeded variant of
+        # each program (extra per-step position-folded PRNG draws) only
+        # compiles — and only runs — when a seeded row is actually in the
+        # batch; pure-unseeded traffic stays on programs byte-identical to
+        # the pre-resume engine.
         if self.paged:
             self._suffix_prefill = jax.jit(
-                self._suffix_prefill_paged_impl, donate_argnums=(0,)
+                self._suffix_prefill_paged_impl, donate_argnums=(0,),
+                static_argnames=("seeded",),
             )
             self._chunk = jax.jit(
                 self._chunk_spec_paged_impl if self.spec_k
                 else self._chunk_paged_impl,
-                donate_argnums=chunk_donate,
+                donate_argnums=chunk_donate, static_argnames=("seeded",),
             )
             self._implant_jits: dict[int, Any] = {}
             #: a request held back by page backpressure (FIFO preserved:
@@ -682,19 +716,40 @@ class LMEngine:
             self._held: "_Request | None" = None
         else:
             self._suffix_prefill = jax.jit(
-                self._suffix_prefill_impl, donate_argnums=(0,)
+                self._suffix_prefill_impl, donate_argnums=(0,),
+                static_argnames=("seeded",),
             )
             self._implant = jax.jit(self._implant_impl, donate_argnums=(0,))
             self._chunk = jax.jit(
                 self._chunk_spec_impl if self.spec_k else self._chunk_impl,
-                donate_argnums=chunk_donate,
+                donate_argnums=chunk_donate, static_argnames=("seeded",),
             )
         self._extract_jits: dict[int, Any] = {}
 
     # -- device programs ---------------------------------------------------- #
 
+    def _seeded_sample(self, logits, seed, pos, temperature, legacy):
+        """Per-row deterministic sampling for the mid-stream resume
+        contract: a seeded row (seed >= 0) draws the token at absolute
+        position ``pos`` from ``fold_in(PRNGKey(seed), pos)`` — a function
+        of (seed, position, logits) only, independent of batch
+        composition, row index and engine RNG history, so a resumed
+        stream on ANY replica continues the exact sampling stream the
+        dead one began. Unseeded rows (seed < 0) keep ``legacy`` (the
+        engine-RNG draw computed by the caller) bit-identically; greedy
+        seeded rows reduce to argmax, which every replica agrees on."""
+        def draw(s, p, lg, t):
+            key = jax.random.fold_in(jax.random.PRNGKey(s), p)
+            return jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
+
+        drawn = jax.vmap(draw)(seed, pos, logits, temperature)
+        greedy = jnp.argmax(logits, axis=-1).astype(drawn.dtype)
+        seeded = jnp.where(temperature <= 0.0, greedy, drawn)
+        return jnp.where(seed >= 0, seeded, legacy.astype(drawn.dtype))
+
     def _suffix_prefill_impl(
-        self, cache, suffix, slen, offset, row, temperature, rng
+        self, cache, suffix, slen, offset, row, temperature, seed, pos, rng,
+        *, seeded=False,
     ):
         """Prefill only the SUFFIX of a prompt whose first ``offset`` slots
         of row ``row`` already hold reused prefix KV. ``cache_index=offset``
@@ -714,7 +769,13 @@ class LMEngine:
         last = jnp.take_along_axis(
             logits, (slen - 1)[:, None, None], axis=1
         )[:, 0]
-        tok = _sample(last, rng, temperature[None])[0]
+        tok = _sample(last, rng, temperature[None])
+        if seeded:  # static: unseeded programs carry zero PRNG-fold ops
+            tok = self._seeded_sample(
+                last, jnp.asarray(seed, jnp.int32)[None],
+                jnp.asarray(pos, jnp.int32)[None], temperature[None], tok,
+            )
+        tok = tok[0]
         cache = {
             name: {
                 "k": jax.lax.dynamic_update_slice_in_dim(
@@ -800,7 +861,7 @@ class LMEngine:
 
     def _chunk_impl(
         self, cache, last_tok, real_len, gen_start, gen_count, active,
-        budget, temperature, rng,
+        budget, temperature, seed, rng, *, seeded=False,
     ):
         """``chunk_steps`` decode steps for ALL rows. Inactive and
         over-budget rows still step (SPMD: no dynamic batch) but never
@@ -829,6 +890,12 @@ class LMEngine:
                 kv_mask=kv_mask,
             )
             nxt = _sample(lg[:, 0], sub, temperature)
+            if seeded:
+                # new token's absolute position is real_len + gen_count
+                # (gen_count is the pre-increment carry value)
+                nxt = self._seeded_sample(
+                    lg[:, 0], seed, real_len + gen_count, temperature, nxt
+                )
             valid = live & (nxt != self.eos_id)
             out = jnp.where(valid, nxt, self.pad_id)
             # dead rows must NOT advance their cache pointers: their slot
@@ -918,7 +985,7 @@ class LMEngine:
 
     def _chunk_spec_impl(
         self, cache, hist, last_tok, real_len, gen_start, gen_count,
-        active, budget, temperature, rng,
+        active, budget, temperature, seed, rng, *, seeded=False,
     ):
         """Speculative twin of _chunk_impl: each scan step drafts up to K
         tokens by prompt-lookup against the row's device-resident history
@@ -942,6 +1009,15 @@ class LMEngine:
             draft, draft_len = propose_draft(
                 hist, L, ngram=self.spec_ngram, k=K
             )
+            # seeded temperature>0 rows must not speculate: spec_accept's
+            # batched accept/resample draws are coupled to batch RNG
+            # history, which breaks the cross-replica resume-determinism
+            # contract. Force draft length 0 (the classic one-token step)
+            # and draw the emitted token per-row below. Greedy seeded
+            # rows keep speculating — argmax needs no RNG.
+            if seeded:
+                seeded_t = (seed >= 0) & (temperature > 0.0)
+                draft_len = jnp.where(seeded_t, 0, draft_len)
             # x_0 is the carry token (its KV is written now, at its slot,
             # exactly as the one-token step does); x_{i+1} = draft i
             x = jnp.concatenate([tok[:, None], draft], axis=1)
@@ -958,6 +1034,13 @@ class LMEngine:
             emitted, n_emit, n_acc = spec_accept(
                 lg, draft, draft_len, sub, temperature
             )
+            # span position 0's absolute position is L: override it with
+            # the position-folded draw (seeded rows only; for greedy
+            # seeded rows this is argmax(lg[:,0]) == what spec emitted)
+            if seeded:
+                emitted = emitted.at[:, 0].set(self._seeded_sample(
+                    lg[:, 0], seed, L, temperature, emitted[:, 0]
+                ))
             (
                 out, valid_i, live_i, eos_step, tok, gen_count, active,
                 prop, acc,
@@ -985,7 +1068,7 @@ class LMEngine:
 
     def _chunk_spec_paged_impl(
         self, cache, hist, last_tok, real_len, gen_count, active, budget,
-        temperature, rng, table,
+        temperature, seed, rng, table, *, seeded=False,
     ):
         """Paged twin of _chunk_spec_impl: the (K+1)-position verify runs
         through the block table with positions (L-1 .. L-1+K) per row —
@@ -1005,6 +1088,10 @@ class LMEngine:
             draft, draft_len = propose_draft(
                 hist, L, ngram=self.spec_ngram, k=K
             )
+            # resume-determinism contract: see _chunk_spec_impl
+            if seeded:
+                seeded_t = (seed >= 0) & (temperature > 0.0)
+                draft_len = jnp.where(seeded_t, 0, draft_len)
             x = jnp.concatenate([tok[:, None], draft], axis=1)
             positions = (L - 1)[:, None] + jnp.arange(K + 1)[None, :]
             write_ok = live0[:, None] & (
@@ -1020,6 +1107,10 @@ class LMEngine:
             emitted, n_emit, n_acc = spec_accept(
                 lg, draft, draft_len, sub, temperature
             )
+            if seeded:
+                emitted = emitted.at[:, 0].set(self._seeded_sample(
+                    lg[:, 0], seed, L, temperature, emitted[:, 0]
+                ))
             (
                 out, valid_i, live_i, eos_step, tok, gen_count, active,
                 prop, acc,
@@ -1057,7 +1148,8 @@ class LMEngine:
         return min(w, self.pager.max_pages_per_row)
 
     def _suffix_prefill_paged_impl(
-        self, cache, suffix, slen, offset, table, temperature, rng
+        self, cache, suffix, slen, offset, table, temperature, seed, pos,
+        rng, *, seeded=False,
     ):
         """Paged twin of _suffix_prefill_impl: one row's prefill piece
         writes tokens [offset, offset+S) through its block table. Pad
@@ -1090,7 +1182,13 @@ class LMEngine:
         last = jnp.take_along_axis(
             logits, (slen - 1)[:, None, None], axis=1
         )[:, 0]
-        tok = _sample(last, rng, temperature[None])[0]
+        tok = _sample(last, rng, temperature[None])
+        if seeded:
+            tok = self._seeded_sample(
+                last, jnp.asarray(seed, jnp.int32)[None],
+                jnp.asarray(pos, jnp.int32)[None], temperature[None], tok,
+            )
+        tok = tok[0]
         return cache, tok, tok != self.eos_id, qerr
 
     def _implant_paged(self, stored, row: int, n16: int):
@@ -1143,7 +1241,7 @@ class LMEngine:
 
     def _chunk_paged_impl(
         self, cache, last_tok, real_len, gen_count, active, budget,
-        temperature, rng, table,
+        temperature, seed, rng, table, *, seeded=False,
     ):
         """Paged twin of _chunk_impl. A row's token space is CONTIGUOUS
         (gen token g sits at token index real_len + g — no quantized gap),
@@ -1169,6 +1267,10 @@ class LMEngine:
                 kv_quant=self.kv_quant,
             )
             nxt = _sample(lg[:, 0], sub, temperature)
+            if seeded:
+                nxt = self._seeded_sample(
+                    lg[:, 0], seed, real_len + gen_count, temperature, nxt
+                )
             valid = live & (nxt != self.eos_id)
             out = jnp.where(valid, nxt, self.pad_id)
             gen_count = jnp.where(live, gen_count + 1, gen_count)
@@ -1312,6 +1414,8 @@ class LMEngine:
         trace: Any = None, want_kv_span: bool = False,
         kv_inject: PreparedKVSpan | None = None,
         session: str | None = None,
+        resume: int = 0,
+        seed: int | None = None,
     ) -> _Request:
         if not ids:
             raise ValueError("empty prompt")
@@ -1413,8 +1517,13 @@ class LMEngine:
             live=queue.Queue() if live else None,
             deadline=deadline, priority=priority,
             want_kv_span=want_kv_span, kv_inject=kv_inject,
-            session=session,
+            session=session, resume=resume, seed=seed,
         )
+        if resume:
+            # the engine half of a gateway mid-stream failover: ids
+            # already contain the committed tokens
+            self.stats["resume_admits"] += 1
+            RESUME_ADMITS.labels(model=self.model_name).inc()
         if trace is not None:
             # engine-stage span under the caller's wire context (a Span or
             # a parsed TraceContext — both carry trace_id/span_id); its
@@ -1427,6 +1536,8 @@ class LMEngine:
                 espan.set_attr("max_new_tokens", max_new_tokens)
                 if priority:
                     espan.set_attr("priority", priority)
+                if resume:
+                    espan.set_attr("resume_tokens", resume)
                 req.model = self.model_name
                 req.espan = espan
                 req.qspan = TRACER.span("queue.wait", parent=espan)
@@ -1473,6 +1584,36 @@ class LMEngine:
         victim.finish()
         return True
 
+    def _resume_args(
+        self,
+        ids: list[int],
+        max_new_tokens: int,
+        resume_tokens: list[int] | None,
+    ) -> tuple[list[int], int, int]:
+        """Fold a gateway mid-stream-failover resume prefix into the
+        admission arguments: the committed tokens become part of the
+        prompt (suffix-prefilled, or covered by a KV-span/host-tier hit)
+        and the generation budget shrinks by what was already emitted, so
+        the stream's TOTAL length is what the original request asked
+        for. Returns ``(ids, max_new_tokens, resume_count)``."""
+        if not resume_tokens:
+            return list(ids), max_new_tokens, 0
+        resume = len(resume_tokens)
+        if max_new_tokens - resume < 1:
+            raise ValueError(
+                f"resume prefix ({resume} tokens) leaves no generation "
+                f"budget (max_new_tokens={max_new_tokens})"
+            )
+        if self.eos_id in resume_tokens:
+            raise ValueError(
+                "resume prefix contains EOS — the stream already finished"
+            )
+        return (
+            list(ids) + [int(t) for t in resume_tokens],
+            max_new_tokens - resume,
+            resume,
+        )
+
     def submit(
         self,
         ids: list[int],
@@ -1485,6 +1626,8 @@ class LMEngine:
         trace: Any = None,
         kv_span: PreparedKVSpan | None = None,
         session: str | None = None,
+        resume_tokens: list[int] | None = None,
+        seed: int | None = None,
     ) -> list[int]:
         """``deadline`` (absolute ``time.monotonic()``) is the end-to-end
         budget; ``timeout_s`` is the legacy knob and becomes the deadline
@@ -1494,13 +1637,20 @@ class LMEngine:
         ``kv_span`` (a ``prepare_kv_span`` result for these exact ids)
         admits by implanting the peer-prefilled span — this engine never
         computes a prefill chunk for the request. ``session`` keys the
-        host-RAM KV tier when it is enabled."""
+        host-RAM KV tier when it is enabled. ``resume_tokens`` (the
+        mid-stream failover contract) extends the prompt with already-
+        committed generated tokens and shrinks the budget to match; only
+        tokens PAST the committed prefix are returned/streamed. ``seed``
+        pins per-row position-folded sampling (see ``_seeded_sample``)."""
         if deadline is None:
             deadline = time.monotonic() + timeout_s
+        ids, max_new_tokens, resume = self._resume_args(
+            ids, max_new_tokens, resume_tokens
+        )
         req = self._enqueue(
             ids, max_new_tokens, temperature, live=False,
             deadline=deadline, priority=priority, trace=trace,
-            kv_inject=kv_span, session=session,
+            kv_inject=kv_span, session=session, resume=resume, seed=seed,
         )
         if not req.done.wait(max(0.0, deadline - time.monotonic())):
             # hand the row back: a timed-out caller must not leave its
@@ -1525,20 +1675,27 @@ class LMEngine:
         trace: Any = None,
         kv_span: PreparedKVSpan | None = None,
         session: str | None = None,
+        resume_tokens: list[int] | None = None,
+        seed: int | None = None,
     ):
         """Yields lists of new tokens as decode chunks complete — the
         streaming data path (KServe v2 generate_stream analog).
-        ``kv_span``/``session``: same contract as :meth:`submit`.
+        ``kv_span``/``session``/``resume_tokens``/``seed``: same contract
+        as :meth:`submit` — a resumed stream yields only tokens past the
+        committed prefix.
 
         Every wait is charged against ONE monotonic deadline: the old
         per-item ``get(timeout=timeout_s)`` granted the full budget per
         chunk, so a slow stream could overrun it by tokens × timeout."""
         if deadline is None:
             deadline = time.monotonic() + timeout_s
+        ids, max_new_tokens, resume = self._resume_args(
+            ids, max_new_tokens, resume_tokens
+        )
         req = self._enqueue(
             ids, max_new_tokens, temperature, live=True,
             deadline=deadline, priority=priority, trace=trace,
-            kv_inject=kv_span, session=session,
+            kv_inject=kv_span, session=session, resume=resume, seed=seed,
         )
         try:
             while True:
@@ -1571,6 +1728,7 @@ class LMEngine:
         timeout_s: float = 120.0,
         deadline: float | None = None,
         trace: Any = None,
+        seed: int | None = None,
     ) -> tuple[dict, dict]:
         """The prefill-pool half of disaggregated serving: run ONLY the
         (chunked) prefill of ``ids`` and return ``(tree, meta)`` — the
@@ -1593,7 +1751,7 @@ class LMEngine:
             deadline = time.monotonic() + timeout_s
         req = self._enqueue(
             list(ids), budget, temperature, live=False,
-            deadline=deadline, trace=trace, want_kv_span=True,
+            deadline=deadline, trace=trace, want_kv_span=True, seed=seed,
         )
         if not req.done.wait(max(0.0, deadline - time.monotonic())):
             req.cancelled.set()
@@ -1832,6 +1990,7 @@ class LMEngine:
         self.gen_count[row] = 0
         self.budget[row] = req.max_new_tokens
         self.temp[row] = req.temperature
+        self.seeds[row] = -1 if req.seed is None else req.seed
         self.stats["admitted"] += 1
         self.stats["max_concurrent"] = max(
             self.stats["max_concurrent"], sum(s is not None for s in self._slots)
@@ -1896,6 +2055,7 @@ class LMEngine:
         self.gen_count[row] = 0
         self.budget[row] = req.max_new_tokens
         self.temp[row] = req.temperature
+        self.seeds[row] = -1 if req.seed is None else req.seed
         self.stats["admitted"] += 1
         self.stats["kv_injected"] += 1
         self.stats["max_concurrent"] = max(
@@ -1960,6 +2120,11 @@ class LMEngine:
         piece = np.full((1, C), self.pad_id, np.int32)
         piece[0, : len(piece_ids)] = piece_ids
         self._rng, sub = jax.random.split(self._rng)
+        # the sampled token's absolute position: one past this piece's
+        # last prompt token (only the FINAL piece's sample is kept, where
+        # this equals len(req.ids) — the first generated position)
+        seed = -1 if req.seed is None else req.seed
+        pos = base + i * C + len(piece_ids)
         if self.paged:
             pages_w = self._pages_w(base + i * C + C)
             self.cache, tok, valid, qerr = self._suffix_prefill(
@@ -1969,7 +2134,10 @@ class LMEngine:
                 base + i * C,
                 jnp.asarray(self.pager.table[row : row + 1, :pages_w].copy()),
                 jnp.float32(req.temperature),
+                seed,
+                pos,
                 sub,
+                seeded=req.seed is not None,
             )
         else:
             self.cache, tok, valid, qerr = self._suffix_prefill(
@@ -1979,7 +2147,10 @@ class LMEngine:
                 base + i * C,
                 row,
                 jnp.float32(req.temperature),
+                seed,
+                pos,
                 sub,
+                seeded=req.seed is not None,
             )
         if self.kv_quant == "int8":
             # same inline sync budget as the final piece's int(tok) below:
@@ -2042,6 +2213,8 @@ class LMEngine:
         req = self._slots[row]
         self._slots[row] = None
         self.active[row] = False
+        # freed row no longer forces the seeded chunk-program variant
+        self.seeds[row] = -1
         was_prefilling = self._prefilling.pop(row, None) is not None
         if (
             req is not None
@@ -2264,7 +2437,11 @@ class LMEngine:
             "real_len": jnp.asarray(self.real_len.copy()),
             "budget": jnp.asarray(self.budget.copy()),
             "temp": jnp.asarray(self.temp.copy()),
+            "seed": jnp.asarray(self.seeds.copy()),
         }
+        # host-side twin of c["seed"]: picks the chunk-program variant
+        # without a device sync (static `seeded` jit specialization)
+        self._carry_seeded = bool((self.seeds >= 0).any())
         if self.spec_k:
             # the device history is rewritten in-graph chunk→chunk; an
             # epoch rebuilds it from the host mirror (current: epochs
@@ -2337,14 +2514,16 @@ class LMEngine:
                 ) = self._chunk(
                     self.cache, c["hist"], c["last_tok"], c["real_len"],
                     c["gen_count"], c["active"], c["budget"], c["temp"],
-                    sub, c["table"],
+                    c["seed"], sub, c["table"],
+                    seeded=self._carry_seeded,
                 )
             else:
                 (
                     self.cache, tok, gen_count, active, toks, valid
                 ) = self._chunk(
                     self.cache, c["last_tok"], c["real_len"], c["gen_count"],
-                    c["active"], c["budget"], c["temp"], sub, c["table"],
+                    c["active"], c["budget"], c["temp"], c["seed"], sub,
+                    c["table"], seeded=self._carry_seeded,
                 )
         elif self.spec_k:
             (
@@ -2353,14 +2532,15 @@ class LMEngine:
             ) = self._chunk(
                 self.cache, c["hist"], c["last_tok"], c["real_len"],
                 c["gen_start"], c["gen_count"], c["active"], c["budget"],
-                c["temp"], sub,
+                c["temp"], c["seed"], sub, seeded=self._carry_seeded,
             )
         else:
             (
                 self.cache, tok, gen_count, active, toks, valid
             ) = self._chunk(
                 self.cache, c["last_tok"], c["real_len"], c["gen_start"],
-                c["gen_count"], c["active"], c["budget"], c["temp"], sub,
+                c["gen_count"], c["active"], c["budget"], c["temp"],
+                c["seed"], sub, seeded=self._carry_seeded,
             )
         c["last_tok"], c["gen_count"], c["active"] = tok, gen_count, active
         self._carry_chunks += 1
@@ -2714,6 +2894,7 @@ def fetch_kv_span(
     *,
     trace: Any = None,
     timeout_s: float = 30.0,
+    seed: int | None = None,
 ) -> PreparedKVSpan | None:
     """Decode-replica side of a disaggregated dispatch: pull the finished
     KV span for ``ids`` from the prefill-pool replica at ``peer`` (the
@@ -2743,9 +2924,14 @@ def fetch_kv_span(
         hook = engine._fault_hooks.get("kv_ship")
         if hook is not None:
             hook(engine)  # chaos seam: DropKVShip raises here
-        body = _json.dumps(
-            {"ids": [int(t) for t in ids], "temperature": float(temperature)}
-        ).encode()
+        payload = {
+            "ids": [int(t) for t in ids], "temperature": float(temperature)
+        }
+        if seed is not None:
+            # resume determinism: the peer's first sampled token (riding
+            # the span meta) must come from the same seeded stream
+            payload["seed"] = int(seed)
+        body = _json.dumps(payload).encode()
         hdrs = {"Content-Type": "application/json"}
         if span:
             hdrs[TRACE_HEADER] = span.header()
@@ -3019,10 +3205,14 @@ class LMEngineModel(LMRuntimeModel):
         for key in eng.overlap:
             eng.overlap[key] = 0 if key == "carry_uploads" else 0.0
 
-    def _pull_kv_span(self, row, peer, trace, deadline):
+    def _pull_kv_span(self, row, peer, trace, deadline, *, ids=None,
+                      seed=None):
         """Fetch + validate this row's KV span from its prefill peer
         (None ⇒ no disaggregation, or any ship failure → local prefill).
-        Runs on the executor / SSE-pump thread — never the event loop."""
+        Runs on the executor / SSE-pump thread — never the event loop.
+        ``ids`` overrides the row's prompt (a resume dispatch pulls the
+        span for prompt+committed, so the peer prefills the FULL resumed
+        context and this replica runs zero prefill pieces)."""
         if not peer:
             return None
         eng = self.engine
@@ -3032,16 +3222,16 @@ class LMEngineModel(LMRuntimeModel):
         if deadline is not None:
             timeout_s = max(0.1, min(timeout_s, deadline - time.monotonic()))
         return fetch_kv_span(
-            eng, peer, self.name, row["ids"], row["temperature"],
-            trace=trace, timeout_s=timeout_s,
+            eng, peer, self.name, ids if ids is not None else row["ids"],
+            row["temperature"], trace=trace, timeout_s=timeout_s, seed=seed,
         )
 
     def _submit_row(
         self, row, deadline: float | None = None, priority: int = 0,
         trace: Any = None, peer: str | None = None,
-        session: str | None = None,
+        session: str | None = None, seed: int | None = None,
     ) -> dict:
-        kv_span = self._pull_kv_span(row, peer, trace, deadline)
+        kv_span = self._pull_kv_span(row, peer, trace, deadline, seed=seed)
         toks = self.engine.submit(
             row["ids"],
             max_new_tokens=self.max_new_tokens,
@@ -3051,6 +3241,7 @@ class LMEngineModel(LMRuntimeModel):
             trace=trace,
             kv_span=kv_span,
             session=session,
+            seed=seed,
         )
         return {"token_ids": toks}
 
@@ -3084,10 +3275,12 @@ class LMEngineModel(LMRuntimeModel):
         ctx = ctx_from_headers(headers)
         peer = _header_get(headers, PREFILL_PEER_HEADER)
         session = _header_get(headers, SESSION_HEADER)
+        seed = seed_from_headers(headers)
         self._admit(len(rows))
         futs = [
             self._executor.submit(
-                self._submit_row, r, deadline, priority, ctx, peer, session
+                self._submit_row, r, deadline, priority, ctx, peer,
+                session, seed,
             )
             for r in rows
         ]
@@ -3108,12 +3301,22 @@ class LMEngineModel(LMRuntimeModel):
         ctx = ctx_from_headers(headers)
         peer = _header_get(headers, PREFILL_PEER_HEADER)
         session = _header_get(headers, SESSION_HEADER)
+        seed = seed_from_headers(headers)
+        resume = resume_from_headers(headers)
         self._admit(1)
 
         def run():
             # the peer pull (blocking HTTP) runs HERE — at first next(),
-            # on the SSE pump thread — never on the event loop
-            kv_span = self._pull_kv_span(row, peer, ctx, deadline)
+            # on the SSE pump thread — never on the event loop. A resume
+            # dispatch pulls the span for prompt+committed: the peer
+            # prefills the FULL resumed context, so this replica admits
+            # with zero prefill pieces
+            span_ids = row["ids"] if not resume else (
+                list(row["ids"]) + list(resume)
+            )
+            kv_span = self._pull_kv_span(
+                row, peer, ctx, deadline, ids=span_ids, seed=seed
+            )
             yield from self.engine.stream(
                 row["ids"],
                 max_new_tokens=self.max_new_tokens,
@@ -3123,6 +3326,8 @@ class LMEngineModel(LMRuntimeModel):
                 trace=ctx,
                 kv_span=kv_span,
                 session=session,
+                resume_tokens=resume,
+                seed=seed,
             )
 
         return _AdmittedStream(run(), lambda: self._release(1))
@@ -3136,6 +3341,7 @@ class LMEngineModel(LMRuntimeModel):
         ctx = ctx_from_headers(headers)
         peer = _header_get(headers, PREFILL_PEER_HEADER)
         session = _header_get(headers, SESSION_HEADER)
+        seed = seed_from_headers(headers)
         self._admit(len(rows))
         try:
             loop = asyncio.get_running_loop()
@@ -3146,7 +3352,7 @@ class LMEngineModel(LMRuntimeModel):
                 *[
                     loop.run_in_executor(
                         self._executor, self._submit_row, r, deadline,
-                        priority, ctx, peer, session,
+                        priority, ctx, peer, session, seed,
                     )
                     for r in rows
                 ],

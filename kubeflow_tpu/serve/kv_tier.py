@@ -22,6 +22,14 @@ Design constraints baked in:
 - **Swap-in consumes the entry** (``take``, not ``get``): the implanted
   row is now the live copy, and a stale host copy must never resurrect
   after further decode extends the session.
+
+Mid-stream failover interplay: a resumed stream (gateway re-dispatch
+with ``x-kft-resume-tokens``) admits prompt+committed as one prefix.
+When the dying replica's session span was parked here — or a peer span
+covers the full resumed context — swap-in/implant replaces the suffix
+prefill entirely and the resumed replica reports ``prefill_pieces == 0``
+for the continuation; the prefix-match check makes this safe because the
+committed tokens extend the stored entry's token key exactly.
 """
 
 from __future__ import annotations

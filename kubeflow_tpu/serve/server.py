@@ -702,6 +702,13 @@ class ModelServer:
                     payload = {"done": True, "n_tokens": total}
                 else:
                     payload = {"error": str(val)}
+                    if isinstance(val, EngineRestarting):
+                        # the watchdog's mid-stream poison is NOT terminal
+                        # for the generation — only for this replica. Mark
+                        # the frame resumable so the gateway re-dispatches
+                        # with the committed prefix instead of forwarding
+                        # the error to the client.
+                        payload["resumable"] = True
                 await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
                 if kind != "tokens":
                     break
@@ -823,6 +830,8 @@ class ModelServer:
             body = await req.json()
             ids = [int(t) for t in body["ids"]]
             temperature = float(body.get("temperature", 0.0))
+            seed = body.get("seed")
+            seed = None if seed is None else int(seed)
             if not ids:
                 raise ValueError("empty ids")
         except Exception as e:
@@ -836,7 +845,8 @@ class ModelServer:
             from kubeflow_tpu.serve.kv_codec import encode_kv_entries
 
             tree, meta = eng.prefill_span(
-                ids, temperature=temperature, deadline=deadline, trace=ctx
+                ids, temperature=temperature, deadline=deadline, trace=ctx,
+                seed=seed,
             )
             blob = encode_kv_entries([(tuple(ids), tree)], meta)
             KV_SHIP_BYTES.labels(model=name, direction="export").inc(

@@ -621,6 +621,135 @@ async def main():
 asyncio.run(main())
 EOF
 
+echo "== mid-stream failover: kill the streaming replica, client sees one unbroken stream =="
+python - <<'EOF'
+import asyncio, json, urllib.request
+
+import jax, jax.numpy as jnp
+
+from kubeflow_tpu.chaos.injectors import kill_mid_stream
+from kubeflow_tpu.gateway.router import ServiceRoute
+from kubeflow_tpu.gateway.server import GatewayConfig, InferenceGateway
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from kubeflow_tpu.serve.engine import LMEngineModel
+from kubeflow_tpu.serve.model import BucketSpec
+from kubeflow_tpu.serve.server import ModelServer
+from kubeflow_tpu.serve.watchdog import EngineRestarting
+
+cfg = TransformerConfig(vocab_size=89, d_model=32, n_layers=2, n_heads=4,
+                        d_ff=64, causal=True, max_seq_len=256,
+                        attn_impl="reference", dtype=jnp.float32)
+tlm = TransformerLM(cfg)
+params = tlm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def replica():
+    m = LMEngineModel(
+        "m", None, config=cfg, max_batch=4, chunk_steps=2,
+        buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+        max_new_tokens=6, eos_id=1, watchdog_interval_s=0.1,
+        watchdog_min_wedge_s=60.0,
+    )
+    m.load()
+    m._params = jax.device_put(params)
+    m.engine.stop()
+    m.engine = m._make_engine().start()
+    return m
+
+
+async def main():
+    m_a, m_b = replica(), replica()
+    ms_a = ModelServer([m_a], http_port=0)
+    ms_b = ModelServer([m_b], http_port=0)
+    await ms_a.start_async()
+    await ms_b.start_async()
+
+    def port_of(ms):
+        (site,) = ms._runner.sites
+        return site._server.sockets[0].getsockname()[1]
+
+    pa, pb = port_of(ms_a), port_of(ms_b)
+    url_a, url_b = (f"http://127.0.0.1:{p}" for p in (pa, pb))
+    # session affinity pins the stream to one replica, so the victim is
+    # deterministic and the resume provably lands on the peer
+    route = ServiceRoute(name="m", affinity="session", max_attempts=4)
+    gw = InferenceGateway(GatewayConfig(
+        probe_interval_s=0.25, failure_threshold=2, recovery_s=60.0,
+        retry_budget_floor=100, routes=[route],
+        backends=[("m", url_a, "default"), ("m", url_b, "default")],
+    ), http_port=0)
+    await gw.start_async()
+    loop = asyncio.get_running_loop()
+
+    def stream(req_id):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.http_port}/v2/models/m/generate_stream",
+            data=json.dumps({"input_ids": [3, 4, 5]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-session-id": "smoke-s1", "x-request-id": req_id},
+        )
+        with urllib.request.urlopen(req, timeout=180) as r:
+            text = r.read().decode()
+        return [json.loads(ln[6:]) for ln in text.splitlines()
+                if ln.startswith("data: ")]
+
+    def predict(i):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.http_port}/v1/models/m:predict",
+            data=json.dumps(
+                {"instances": [{"input_ids": [3 + i % 5, 4, 5]}]}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=180) as r:
+            return r.status
+
+    def metric(line_prefix):
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{gw.http_port}/metrics", timeout=30
+        ).read().decode()
+        for ln in text.splitlines():
+            if ln.startswith(line_prefix):
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    try:
+        for i in range(4):  # warm both replicas through their compiles
+            assert await loop.run_in_executor(None, predict, i) == 200
+        base = await loop.run_in_executor(None, stream, "smoke-base")
+        assert all("error" not in f for f in base), base
+        base_toks = [t for f in base for t in f.get("token_ids", [])]
+
+        victim_b = gw._affine_pick(route, "default", "session:smoke-s1")
+        victim, peer = (m_a, m_b) if victim_b.url == url_a else (m_b, m_a)
+        kill_mid_stream(
+            victim.engine, after_tokens=2,
+            action=lambda eng: eng.poison(
+                EngineRestarting("smoke: replica killed mid-stream")
+            ),
+        )
+        frames = await loop.run_in_executor(None, stream, "smoke-failover")
+        assert all("error" not in f for f in frames), frames
+        toks = [t for f in frames for t in f.get("token_ids", [])]
+        assert toks == base_toks, (toks, base_toks)
+        assert frames[-1]["done"] and frames[-1]["n_tokens"] == len(base_toks)
+        resumes = await loop.run_in_executor(
+            None, metric,
+            'kft_gateway_stream_resumes_total{outcome="ok",service="m"}')
+        assert resumes >= 1, "no successful stream resume recorded"
+        assert peer.engine.stats["resume_admits"] >= 1
+        print(f"mid-stream failover OK: {len(toks)} tokens unbroken across "
+              f"a replica kill, stream_resumes_ok={resumes:.0f}")
+    finally:
+        await gw.stop_async()
+        m_a.unload()
+        m_b.unload()
+        await ms_a.stop_async()
+        await ms_b.stop_async()
+
+asyncio.run(main())
+EOF
+
 echo "== autoscaler burst: 1->3->1->0 scale cycle, zero failures, prefix-KV transfer =="
 python - <<'EOF'
 import asyncio, json, time, urllib.request

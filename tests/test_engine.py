@@ -1204,3 +1204,84 @@ def test_engine_with_sliding_window(model_and_params):
         assert eng.stats["prefix_hits"] > before
     finally:
         eng.stop()
+
+
+# ------------------------------------------- mid-stream failover resume
+
+
+def test_engine_resume_tokens_continue_greedy_identically(model_and_params):
+    """The resume contract: admitting prompt+committed with a shrunk
+    budget emits exactly the tokens an uninterrupted run would have
+    produced past the committed prefix — the engine half of the gateway's
+    transparent mid-stream failover."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=4, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    try:
+        rng = np.random.default_rng(11)
+        for ids in _prompts(rng, 4):
+            full = eng.submit(ids, max_new_tokens=10)
+            if len(full) < 3:
+                continue  # EOS too early to split meaningfully
+            for cut in (1, len(full) // 2, len(full) - 1):
+                admits0 = eng.stats["resume_admits"]
+                rest = eng.submit(
+                    ids, max_new_tokens=10, resume_tokens=full[:cut]
+                )
+                assert rest == full[cut:], (ids, cut, rest, full)
+                assert eng.stats["resume_admits"] == admits0 + 1
+    finally:
+        eng.stop()
+
+
+def test_engine_seeded_sampling_deterministic_and_resumable(model_and_params):
+    """Seeded temperature>0 draws: token t comes from
+    fold_in(PRNGKey(seed), position_of_t), so (a) two runs with the same
+    seed agree, (b) a resumed run continues the exact sampling stream,
+    and (c) a different seed diverges (the draws are real, not greedy)."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=4, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    try:
+        ids = [5, 9, 33, 60, 7]
+        kw = dict(max_new_tokens=10, temperature=0.9)
+        a = eng.submit(ids, seed=1234, **kw)
+        b = eng.submit(ids, seed=1234, **kw)
+        assert a == b, (a, b)
+        if len(a) >= 3:
+            cut = len(a) // 2
+            rest = eng.submit(ids, seed=1234, resume_tokens=a[:cut], **kw)
+            assert rest == a[cut:], (a, cut, rest)
+        # a distinct seed must be able to diverge somewhere
+        others = [eng.submit(ids, seed=s, **kw) for s in (77, 78, 79)]
+        assert any(o != a for o in others), (a, others)
+        # unseeded requests still ride the legacy engine-RNG path
+        assert eng.submit(ids, max_new_tokens=6) == eng.submit(
+            ids, max_new_tokens=6
+        )
+    finally:
+        eng.stop()
+
+
+def test_engine_resume_validation_errors(model_and_params):
+    """A resume prefix that exhausts the budget, or that already contains
+    EOS, is a caller error rejected at admission — never a row wasted."""
+    model, params = model_and_params
+    eng = LMEngine(
+        model, CFG, params, max_batch=2, max_seq=64, chunk_steps=2,
+        prefill_buckets=(32,), eos_id=EOS,
+    ).start()
+    try:
+        with pytest.raises(ValueError, match="no generation budget"):
+            eng.submit([5, 6, 7], max_new_tokens=3, resume_tokens=[8, 9, 10])
+        with pytest.raises(ValueError, match="EOS"):
+            eng.submit([5, 6, 7], max_new_tokens=8, resume_tokens=[8, EOS])
+        # boundary: resume leaving exactly one token of budget is admitted
+        out = eng.submit([5, 6, 7], max_new_tokens=3, resume_tokens=[8, 9])
+        assert len(out) <= 1
+    finally:
+        eng.stop()

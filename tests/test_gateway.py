@@ -714,8 +714,9 @@ def test_kill_backend_injector_and_wedge_resume():
 
 
 def test_gateway_sse_passthrough_error_frame_on_midstream_death():
-    """A backend that dies mid-SSE must surface a clean terminal error
-    frame to the client, not a torn socket."""
+    """With stream resume disabled, a backend that dies mid-SSE must
+    surface a clean terminal error frame to the client, not a torn
+    socket — the pre-failover contract, still the terminal fallback."""
     from aiohttp import web
     from aiohttp.test_utils import TestServer
 
@@ -738,7 +739,7 @@ def test_gateway_sse_passthrough_error_frame_on_midstream_death():
         srv = TestServer(app)
         await srv.start_server()
         gw = InferenceGateway(GatewayConfig(
-            probe_interval_s=30.0,
+            probe_interval_s=30.0, stream_resume=False,
             backends=[("m", f"http://127.0.0.1:{srv.port}", "default")],
         ))
         client = await _gateway_client(gw)
@@ -751,6 +752,212 @@ def test_gateway_sse_passthrough_error_frame_on_midstream_death():
                       if line.startswith("data: ")]
             assert frames[0] == {"token_ids": [1]}
             assert "error" in frames[-1]  # clean terminal frame
+        finally:
+            await client.close()
+            await srv.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_stream_frame_alignment_drops_torn_half_frame():
+    """Satellite regression: the proxy forwards whole SSE frames only. A
+    backend dying mid-write must never leak a torn half-frame into the
+    client's stream (the old raw ``iter_any`` passthrough did)."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    async def run():
+        async def ready(request):
+            return web.json_response({"ready": True})
+
+        async def stream(request):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            await resp.write(b'data: {"token_ids": [1]}\n\n')
+            # half of a second frame, then death mid-write
+            await resp.write(b'data: {"token_')
+            await resp.drain()
+            request.transport.close()
+            return resp
+
+        app = web.Application()
+        app.router.add_get("/v2/health/ready", ready)
+        app.router.add_post("/v2/models/m/generate_stream", stream)
+        srv = TestServer(app)
+        await srv.start_server()
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, stream_resume=False,
+            backends=[("m", f"http://127.0.0.1:{srv.port}", "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            r = await client.post("/v2/models/m/generate_stream",
+                                  json={"prompt": "x"})
+            assert r.status == 200
+            text = (await r.read()).decode()
+            assert 'data: {"token_\n' not in text  # torn bytes dropped
+            frames = [json.loads(line[6:]) for line in text.splitlines()
+                      if line.startswith("data: ")]
+            assert frames[0] == {"token_ids": [1]}
+            assert "error" in frames[-1]
+            assert len(frames) == 2
+        finally:
+            await client.close()
+            await srv.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_stream_resume_splices_continuation_invisibly():
+    """The tentpole, pinned with scripted backends: the first upstream
+    dies after two token frames; the gateway re-dispatches to the peer
+    carrying ``x-kft-resume-tokens`` (and the same gateway-stamped
+    ``x-kft-seed``), and the client reads ONE unbroken stream whose
+    terminal ``done`` frame counts the full generation."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    seen = []  # (resume_header, seed_header) per dispatch
+
+    async def run():
+        async def ready(request):
+            return web.json_response({"ready": True})
+
+        async def dying(request):
+            seen.append((request.headers.get("x-kft-resume-tokens"),
+                         request.headers.get("x-kft-seed")))
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            await resp.write(b'data: {"token_ids": [1]}\n\n')
+            await resp.write(b'data: {"token_ids": [2]}\n\n')
+            await resp.drain()
+            request.transport.close()
+            return resp
+
+        async def resuming(request):
+            seen.append((request.headers.get("x-kft-resume-tokens"),
+                         request.headers.get("x-kft-seed")))
+            committed = [
+                int(t) for t in
+                request.headers.get("x-kft-resume-tokens", "").split(",")
+                if t
+            ]
+            assert committed == [1, 2]
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            # a real replica emits only tokens PAST the committed prefix
+            # and counts only its own segment in n_tokens
+            await resp.write(b'data: {"token_ids": [3, 4]}\n\n')
+            await resp.write(b'data: {"done": true, "n_tokens": 2}\n\n')
+            await resp.write_eof()
+            return resp
+
+        async def mk(handler):
+            app = web.Application()
+            app.router.add_get("/v2/health/ready", ready)
+            app.router.add_post("/v2/models/m/generate_stream", handler)
+            srv = TestServer(app)
+            await srv.start_server()
+            return srv, f"http://127.0.0.1:{srv.port}"
+
+        # insertion order makes the dying backend the first pick
+        srv_a, url_a = await mk(dying)
+        srv_b, url_b = await mk(resuming)
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, retry_budget_floor=50,
+            routes=[ServiceRoute(name="m", max_attempts=3)],
+            backends=[("m", url_a, "default"), ("m", url_b, "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            ok0 = _metric("kft_gateway_stream_resumes_total",
+                          service="m", outcome="ok")
+            retries0 = _metric("kft_gateway_retries_total", service="m")
+            r = await client.post("/v2/models/m/generate_stream",
+                                  json={"prompt": "x"},
+                                  headers={"x-request-id": "resume-1"})
+            assert r.status == 200
+            text = (await r.read()).decode()
+            frames = [json.loads(line[6:]) for line in text.splitlines()
+                      if line.startswith("data: ")]
+            assert all("error" not in f for f in frames), frames
+            toks = [t for f in frames for t in f.get("token_ids", [])]
+            assert toks == [1, 2, 3, 4]
+            # the spliced done frame counts the WHOLE generation, not the
+            # resumed replica's own segment
+            assert frames[-1] == {"done": True, "n_tokens": 4}
+            assert _metric("kft_gateway_stream_resumes_total",
+                           service="m", outcome="ok") == ok0 + 1
+            assert _metric("kft_gateway_retries_total",
+                           service="m") == retries0 + 1
+            # first dispatch had no resume header; the resume carried the
+            # committed prefix and the SAME gateway-stamped seed
+            assert seen[0][0] is None and seen[1][0] == "1,2"
+            assert seen[0][1] is not None and seen[0][1] == seen[1][1]
+        finally:
+            await client.close()
+            await srv_a.close()
+            await srv_b.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_stream_resume_budget_exhausted_clean_terminal_frame():
+    """Resume attempts are bounded by the route's retry budget: when the
+    lone backend keeps dying, the client still ends with the pre-failover
+    contract — committed token frames, then ONE clean terminal error
+    frame — and the exhaustion is visible in the resume metric."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    calls = []
+
+    async def run():
+        async def ready(request):
+            return web.json_response({"ready": True})
+
+        async def always_dies(request):
+            calls.append(request.headers.get("x-kft-resume-tokens"))
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            await resp.write(b'data: {"token_ids": [7]}\n\n')
+            await resp.drain()
+            request.transport.close()
+            return resp
+
+        app = web.Application()
+        app.router.add_get("/v2/health/ready", ready)
+        app.router.add_post("/v2/models/m/generate_stream", always_dies)
+        srv = TestServer(app)
+        await srv.start_server()
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, retry_budget_floor=50,
+            routes=[ServiceRoute(name="m", max_attempts=2)],
+            backends=[("m", f"http://127.0.0.1:{srv.port}", "default")],
+        ))
+        client = await _gateway_client(gw)
+        try:
+            ex0 = _metric("kft_gateway_stream_resumes_total",
+                          service="m", outcome="budget_exhausted")
+            r = await client.post("/v2/models/m/generate_stream",
+                                  json={"prompt": "x"})
+            assert r.status == 200
+            text = (await r.read()).decode()
+            frames = [json.loads(line[6:]) for line in text.splitlines()
+                      if line.startswith("data: ")]
+            # max_attempts=2: the original dispatch + ONE resume (to the
+            # lone backend again), then exhaustion
+            assert len(calls) == 2 and calls[1] == "7"
+            assert "error" in frames[-1]
+            assert sum("error" in f for f in frames) == 1
+            assert _metric(
+                "kft_gateway_stream_resumes_total",
+                service="m", outcome="budget_exhausted",
+            ) == ex0 + 1
         finally:
             await client.close()
             await srv.close()
@@ -1314,6 +1521,164 @@ def test_wedged_engine_behind_gateway_watchdog_restart_zero_failures():
         finally:
             if release is not None:
                 release()
+            await client.close()
+            m_a.unload()
+            m_b.unload()
+            await srv_a.close()
+            await srv_b.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_mid_stream_kill_failover_stream_completes_identically():
+    """THE tentpole acceptance e2e: two engine-backed replicas behind the
+    real gateway; the KillMidStream injector hard-fails the replica
+    serving a stream after it has committed tokens to the client. The
+    gateway re-dispatches the stream to the surviving peer with the
+    committed prefix, and the client reads a token sequence identical to
+    an uninterrupted greedy run — zero error frames, one trace id holding
+    both the failed proxy span and the stream.resume span."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiohttp.test_utils import TestServer
+
+    from kubeflow_tpu.chaos.injectors import kill_mid_stream
+    from kubeflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from kubeflow_tpu.serve.engine import LMEngineModel
+    from kubeflow_tpu.serve.model import BucketSpec
+    from kubeflow_tpu.serve.watchdog import EngineRestarting
+
+    cfg = TransformerConfig(
+        vocab_size=89, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        causal=True, max_seq_len=256, attn_impl="reference",
+        dtype=jnp.float32,
+    )
+    tlm = TransformerLM(cfg)
+    params = tlm.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    def replica():
+        m = LMEngineModel(
+            "m", None, config=cfg, max_batch=4, chunk_steps=2,
+            buckets=BucketSpec(batch_sizes=(1,), seq_lens=(32,)),
+            max_new_tokens=6, eos_id=1,
+            watchdog_interval_s=0.1, watchdog_min_wedge_s=60.0,
+        )
+        m.load()
+        m._params = jax.device_put(params)
+        m.engine.stop()
+        m.engine = m._make_engine().start()
+        return m
+
+    async def run():
+        m_a, m_b = replica(), replica()
+        ms_a, ms_b = ModelServer([m_a]), ModelServer([m_b])
+        srv_a, srv_b = TestServer(ms_a.build_app()), TestServer(ms_b.build_app())
+        await srv_a.start_server()
+        await srv_b.start_server()
+        url_a = f"http://127.0.0.1:{srv_a.port}"
+        url_b = f"http://127.0.0.1:{srv_b.port}"
+        # session affinity makes the victim deterministic: the baseline
+        # AND the failover stream start on the session's sticky replica
+        route = ServiceRoute(name="m", affinity="session", max_attempts=4)
+        gw = InferenceGateway(GatewayConfig(
+            probe_interval_s=30.0, failure_threshold=2, recovery_s=60.0,
+            retry_budget_floor=100, routes=[route],
+            backends=[("m", url_a, "default"), ("m", url_b, "default")],
+        ))
+        client = await _gateway_client(gw)
+        hdrs = {"x-session-id": "chaos-s1"}
+        disarm = None
+        try:
+            # warm both replicas through their compiles
+            for i in range(4):
+                r = await client.post(
+                    "/v1/models/m:predict",
+                    json={"instances": [{"input_ids": [3 + i, 4, 5]}]},
+                )
+                assert r.status == 200, await r.text()
+
+            async def stream_frames(extra=None):
+                r = await client.post(
+                    "/v2/models/m/generate_stream",
+                    json={"input_ids": [3, 4, 5]},
+                    headers={**hdrs, **(extra or {})},
+                )
+                assert r.status == 200, await r.text()
+                text = (await r.read()).decode()
+                return [
+                    json.loads(line[6:]) for line in text.splitlines()
+                    if line.startswith("data: ")
+                ]
+
+            base = await stream_frames({"x-request-id": "base-run"})
+            assert all("error" not in f for f in base), base
+            base_toks = [t for f in base for t in f.get("token_ids", [])]
+            assert base[-1]["done"] and len(base_toks) >= 4
+
+            # the session's sticky replica is the victim; arm the killer
+            # there (in-process: SIGKILL would take the test down, so the
+            # action is the exact poison a dying replica's watchdog path
+            # produces — the resumable mid-stream signal)
+            victim_b = gw._affine_pick(route, "default", "session:chaos-s1")
+            assert victim_b is not None
+            victim, peer = (
+                (m_a, m_b) if victim_b.url == url_a else (m_b, m_a)
+            )
+            inj0 = _metric("kft_chaos_injected_total",
+                           kind="kill_mid_stream")
+            ok0 = _metric("kft_gateway_stream_resumes_total",
+                          service="m", outcome="ok")
+            adm0 = _metric("kft_engine_resume_admits_total", model="m")
+            peer_admits0 = peer.engine.stats["resume_admits"]
+            disarm = kill_mid_stream(
+                victim.engine, after_tokens=2,
+                action=lambda eng: eng.poison(
+                    EngineRestarting("chaos: replica killed mid-stream")
+                ),
+            )
+
+            frames = await stream_frames({"x-request-id": "failover-run"})
+            assert all("error" not in f for f in frames), frames
+            toks = [t for f in frames for t in f.get("token_ids", [])]
+            # the spliced stream is the uninterrupted greedy run, token
+            # for token, and the done frame counts the whole generation
+            assert toks == base_toks, (toks, base_toks)
+            assert frames[-1]["done"]
+            assert frames[-1]["n_tokens"] == len(base_toks)
+            assert _metric("kft_chaos_injected_total",
+                           kind="kill_mid_stream") == inj0 + 1
+            assert _metric("kft_gateway_stream_resumes_total",
+                           service="m", outcome="ok") == ok0 + 1
+            assert _metric("kft_engine_resume_admits_total",
+                           model="m") == adm0 + 1
+            assert peer.engine.stats["resume_admits"] == peer_admits0 + 1
+
+            # one trace id carries the whole story: the failed proxy span
+            # AND the stream.resume span that continued the request
+            from kubeflow_tpu.obs.trace import TRACER
+            snap = TRACER.snapshot(limit=64)
+            resumed = [
+                t for t in snap["traces"]
+                if any(s["name"] == "stream.resume" for s in t["spans"])
+            ]
+            assert resumed, "stream.resume span must survive tail sampling"
+            tr = resumed[0]
+            proxies = [s for s in tr["spans"] if s["name"] == "proxy"]
+            assert any(s["status"] == "error" for s in proxies)
+            assert any(
+                ev["name"] == "mid_stream_failure"
+                for s in proxies for ev in s["events"]
+            )
+        finally:
+            if disarm is not None:
+                disarm()
             await client.close()
             m_a.unload()
             m_b.unload()
