@@ -601,6 +601,121 @@ def bench_katib() -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# config 4b: serving goodput under open-loop load — steady, chaos-wedged,
+# and autoscale-cycling runs through the REAL gateway + fleet (CPU anchor)
+# --------------------------------------------------------------------------- #
+
+
+def bench_serving_load() -> dict:
+    """Seeded open-loop Poisson load against the real InferenceGateway +
+    autoscaled ReplicaFleet over HTTP/SSE (kubeflow_tpu/loadgen). Three
+    runs: steady (pinned fleet), chaos (same schedule + a WedgeEngine
+    overlay mid-run), and scale (bursty on-off arrivals, min_replicas=0,
+    cold-recovery timing). Deliberately NOT a device bench: this is the
+    CPU-runnable trajectory anchor — it must emit real numbers even when
+    the TPU tunnel is dead, so it lives in all_benches only."""
+    import asyncio
+    import dataclasses as _dc
+
+    from kubeflow_tpu.chaos.plan import FaultPlan, WedgeEngine
+    from kubeflow_tpu.loadgen import ChaosOverlay, TenantSpec, WorkloadMix
+    from kubeflow_tpu.loadgen.harness import HarnessConfig, run_serving_load
+
+    # 4 distinct (prompt_len, budget) shapes keeps per-replica warmup
+    # compiles bounded; deadline stays generous (CPU decode can't make a
+    # tight one) while slo_ms=2000 is what goodput is scored against
+    mix = WorkloadMix(
+        prompt_lens=(6, 10),
+        output_lens=(4, 8),
+        tenants=(
+            TenantSpec(
+                "interactive", weight=2.0, priority=2,
+                deadline_ms=30_000.0, slo_ms=2_000.0,
+            ),
+            TenantSpec(
+                "batch", weight=1.0, priority=0, adapter="batch-v1",
+                slo_ms=2_000.0,
+            ),
+        ),
+        vocab=80,
+        seed=7,
+    )
+    steady_cfg = HarnessConfig(
+        seed=7, process="poisson", rate_rps=4.0, duration_s=8.0, mix=mix,
+        initial_replicas=2, max_replicas=2, min_replicas=2,
+    )
+    chaos_cfg = _dc.replace(steady_cfg, chaos=ChaosOverlay(
+        plan=FaultPlan(
+            faults=(WedgeEngine(model="m", hold_s=3.0),), seed=7
+        ),
+        at_s=3.0, window_s=5.0,
+    ))
+    # warm requests finish in ~15ms, so average concurrency at the burst
+    # rate is ~30*0.015 ≈ 0.45 — the target must sit below that for the
+    # burst to drive a panic scale-up
+    scale_cfg = HarnessConfig(
+        seed=7, process="onoff", rate_rps=1.0, burst_rps=30.0,
+        period_s=4.0, duration_s=8.0, mix=mix,
+        initial_replicas=1, max_replicas=2, min_replicas=0,
+        kpa_target=0.3, measure_cold_recovery=True,
+    )
+
+    steady = asyncio.run(run_serving_load(steady_cfg))
+    chaos = asyncio.run(run_serving_load(chaos_cfg))
+    scale = asyncio.run(run_serving_load(scale_cfg))
+
+    g = steady["goodput"]["overall"]
+    lat = steady["latency"]
+    return {
+        "metric": "serving_load_goodput",
+        "value": g["goodput"],
+        "unit": "fraction of offered load completed in SLO",
+        "vs_baseline": None,
+        "detail": {
+            "steady": {
+                "offered": g["offered"],
+                "goodput": g["goodput"],
+                "shed": g["shed"],
+                "error": g["error"],
+                "ttft_p50_ms": lat["ttft_ms"]["p50"],
+                "ttft_p99_ms": lat["ttft_ms"]["p99"],
+                "tpot_p50_ms": lat["tpot_ms"]["p50"],
+                "client_e2e_p99_ms": lat["client_e2e_ms"]["p99"],
+            },
+            "chaos": {
+                **{
+                    k: chaos["chaos"][k]
+                    for k in (
+                        "faults", "window_s", "goodput_dip",
+                        "client_visible_failures",
+                    )
+                },
+                "goodput_in_window": chaos["chaos"]["in_window"]["goodput"],
+                "goodput_outside_window": (
+                    chaos["chaos"]["outside_window"]["goodput"]
+                ),
+            },
+            "autoscale": {
+                "scale_up_latency_s": (
+                    scale.get("autoscale", {}).get("scale_up_latency_s")
+                ),
+                "replicas_peak": (
+                    scale.get("autoscale", {}).get("replicas_peak")
+                ),
+                "cold_recovery_s": (
+                    scale.get("cold_recovery", {}).get("recovery_s")
+                ),
+                "cold_recovery_outcome": (
+                    scale.get("cold_recovery", {}).get("outcome")
+                ),
+            },
+            "seeded": "same seed -> identical arrival schedule and "
+            "workload plan across runs (arrivals are pure values)",
+        },
+    }
+
+
+# --------------------------------------------------------------------------- #
 # config 5: KServe BERT predictor p50/p99 + cold start (REST + gRPC)
 # --------------------------------------------------------------------------- #
 
@@ -1841,10 +1956,15 @@ def main(argv: list[str] | None = None) -> int:
         bench_engine, bench_engine_decode, bench_engine_disagg,
         bench_engine_resume, bench_train_overlap,
     )
+    # serving_load is deliberately NOT in device_benches: it is the
+    # CPU-runnable trajectory anchor, and device membership would skip
+    # it (emitting *_unavailable) whenever the TPU tunnel is down —
+    # exactly when the anchor matters most
     all_benches = (
         bench_mnist, bench_resnet, bench_bert, bench_katib, bench_serving,
         bench_generate, bench_engine, bench_engine_decode,
         bench_engine_disagg, bench_engine_resume, bench_train_overlap,
+        bench_serving_load,
     )
     # `python bench.py engine_decode [...]` runs just the named configs
     # (names = bench_* suffixes); no args runs the whole suite + headline

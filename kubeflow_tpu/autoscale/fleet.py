@@ -35,6 +35,11 @@ KV_TRANSFERS = prom.REGISTRY.counter(
     "prefix-KV entries moved between replicas after a ring remap",
     ("service",),
 )
+REPLICAS = prom.REGISTRY.gauge(
+    names.FLEET_REPLICAS,
+    "replicas the fleet currently runs (actuated, not desired)",
+    ("service",),
+)
 
 
 @dataclasses.dataclass
@@ -81,6 +86,10 @@ class ReplicaFleet:
         #: per-service ticks, but kicks and direct calls may interleave)
         self._lock = asyncio.Lock()
         self.stats = {"launched": 0, "stopped": 0, "kv_entries_moved": 0}
+        #: read-only scale timeline for reporters (loadgen/reporter.py):
+        #: one entry per actuated membership change, monotonic-stamped —
+        #: {"t": time.monotonic(), "replicas": n, "direction": "up"|"down"}
+        self.events: list[dict] = []
 
     # -- actuator protocol ----------------------------------------------- #
 
@@ -131,6 +140,12 @@ class ReplicaFleet:
             # ready → activator flush (prefill-role replicas never become
             # traffic-selectable; they only serve kv_span:prefill pulls)
             self.pool.add(self.service, url, role=self.role)
+        self.events.append({
+            "t": time.monotonic(),
+            "replicas": len(self._replicas),
+            "direction": "up",
+        })
+        REPLICAS.labels(service=self.service).set(len(self._replicas))
         logger.warning(
             "fleet %s: replica #%d up at %s (%d total)",
             self.service, index, url, len(self._replicas),
@@ -154,6 +169,12 @@ class ReplicaFleet:
                 await asyncio.sleep(0.02)
         await replica.stop()
         self.stats["stopped"] += 1
+        self.events.append({
+            "t": time.monotonic(),
+            "replicas": len(self._replicas),
+            "direction": "down",
+        })
+        REPLICAS.labels(service=self.service).set(len(self._replicas))
         logger.warning(
             "fleet %s: replica #%d at %s drained and stopped (%d left)",
             self.service, replica.index, replica.url, len(self._replicas),

@@ -912,6 +912,161 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _loadgen_mix(args):
+    """Build the WorkloadMix from ``--tenant`` key=value specs (repeatable);
+    no ``--tenant`` → one default tenant carrying --slo-ms/--deadline-ms."""
+    from kubeflow_tpu.loadgen import TenantSpec, WorkloadMix
+
+    tenants = []
+    for spec in args.tenant or ():
+        kv = dict(part.split("=", 1) for part in spec.split(",") if part)
+        try:
+            tenants.append(TenantSpec(
+                name=kv.pop("name"),
+                weight=float(kv.pop("weight", 1.0)),
+                priority=(
+                    int(kv.pop("priority")) if "priority" in kv else None
+                ),
+                deadline_ms=(
+                    float(kv.pop("deadline_ms"))
+                    if "deadline_ms" in kv else None
+                ),
+                slo_ms=float(kv.pop("slo_ms")) if "slo_ms" in kv else None,
+                adapter=kv.pop("adapter", None),
+            ))
+        except KeyError as e:
+            raise SystemExit(f"kft loadgen: --tenant spec missing {e}")
+        if kv:
+            raise SystemExit(
+                f"kft loadgen: unknown --tenant key(s) {sorted(kv)}"
+            )
+    if not tenants:
+        tenants = [TenantSpec(
+            "default",
+            deadline_ms=args.deadline_ms,
+            slo_ms=args.slo_ms,
+        )]
+    return WorkloadMix(
+        prompt_lens=tuple(int(x) for x in args.prompt_lens.split(",")),
+        output_lens=tuple(int(x) for x in args.output_lens.split(",")),
+        tenants=tuple(tenants),
+        seed=args.seed,
+    )
+
+
+def _loadgen_arrivals(args):
+    """Arrival source from flags: a seeded process or a replayed dump."""
+    from kubeflow_tpu.loadgen import (
+        OnOffArrivals,
+        PoissonArrivals,
+        ReplayArrivals,
+    )
+
+    if args.process == "replay":
+        if not args.trace_file:
+            raise SystemExit(
+                "kft loadgen: --process replay needs --trace-file "
+                "(a `kft trace dump` output)"
+            )
+        return ReplayArrivals.from_file(args.trace_file)
+    if args.process == "onoff":
+        return OnOffArrivals(
+            base_rps=args.rate, burst_rps=args.burst_rps,
+            period_s=args.period_s, duration_s=args.duration,
+            seed=args.seed,
+        )
+    return PoissonArrivals(
+        rate_rps=args.rate, duration_s=args.duration, seed=args.seed
+    )
+
+
+def _cmd_loadgen_schedule(args) -> int:
+    """Print the seeded arrival schedule — the determinism contract made
+    inspectable: the same flags always print the same offsets."""
+    arrivals = _loadgen_arrivals(args)
+    schedule = arrivals.schedule()
+    out = {
+        "process": args.process,
+        "seed": args.seed,
+        "n": len(schedule),
+        "offsets_s": [round(t, 6) for t in schedule],
+    }
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def _cmd_loadgen_run(args) -> int:
+    """Open-loop load against an ALREADY-RUNNING gateway (external
+    process): fire the schedule, scrape /metrics before and after, emit
+    the goodput report. The in-process bench/smoke path is
+    ``python bench.py serving_load``."""
+    import asyncio
+
+    from kubeflow_tpu.loadgen import LoadClient, build_report, scrape_metrics
+
+    arrivals = _loadgen_arrivals(args)
+    schedule = arrivals.schedule()
+    mix = _loadgen_mix(args)
+    if args.process == "replay":
+        specs = mix.plan_for_replay(
+            arrivals.requests, cap_new_tokens=args.max_new_tokens
+        )
+    else:
+        specs = mix.plan(len(schedule))
+    client = LoadClient(
+        args.url, args.model,
+        stream=not args.no_stream,
+        request_timeout_s=args.timeout,
+    )
+
+    async def drive():
+        metrics_url = args.url.rstrip("/") + "/metrics"
+        try:
+            baseline = await scrape_metrics(metrics_url)
+        except Exception:
+            baseline = None  # gateway may not expose /metrics — degrade
+        results = await client.run(schedule, specs)
+        try:
+            after = await scrape_metrics(metrics_url)
+        except Exception:
+            after = None
+        traces = None
+        if args.traces_url:
+            traces = json.loads(await scrape_metrics(
+                args.traces_url.rstrip("/") + "/debug/traces?limit=256"
+            ))
+        return build_report(
+            results=results,
+            run={
+                "bench": "loadgen_run",
+                "url": args.url,
+                "model": args.model,
+                "process": args.process,
+                "seed": args.seed,
+                "offered_requests": len(schedule),
+                "duration_s": args.duration,
+            },
+            gateway_metrics=after,
+            baseline_metrics=baseline,
+            traces=traces,
+        )
+
+    report = asyncio.run(drive())
+    text = json.dumps(report, indent=1, sort_keys=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        overall = report["goodput"]["overall"]
+        print(
+            f"wrote {args.output} (offered={overall['offered']} "
+            f"goodput={overall['goodput']})"
+        )
+    else:
+        print(text)
+    overall = report["goodput"]["overall"]
+    return 1 if overall["error"] else 0
+
+
 def _cmd_version(_args) -> int:
     import kubeflow_tpu
 
@@ -1110,6 +1265,73 @@ def main(argv: list[str] | None = None) -> int:
     trd.add_argument("-o", "--output", default=None,
                      help="write to a file instead of stdout")
     trd.set_defaults(fn=_cmd_trace)
+
+    lg = sub.add_parser(
+        "loadgen",
+        help="open-loop load generation: seeded traffic against a live "
+             "gateway, SLO-goodput reports",
+    )
+    lg_sub = lg.add_subparsers(dest="action", required=True)
+
+    def add_loadgen_flags(parser) -> None:
+        parser.add_argument("--process", default="poisson",
+                            choices=("poisson", "onoff", "replay"),
+                            help="arrival process (replay needs "
+                                 "--trace-file)")
+        parser.add_argument("--rate", type=float, default=4.0,
+                            help="arrival rate rps (onoff: base rate)")
+        parser.add_argument("--burst-rps", type=float, default=16.0,
+                            help="onoff: on-phase rate")
+        parser.add_argument("--period-s", dest="period_s", type=float,
+                            default=4.0, help="onoff: on+off cycle length")
+        parser.add_argument("--duration", type=float, default=10.0,
+                            help="schedule length in seconds")
+        parser.add_argument("--seed", type=int, default=0,
+                            help="same seed -> identical schedule + draws")
+        parser.add_argument("--trace-file", default=None,
+                            help="`kft trace dump` output to replay")
+
+    lgs = lg_sub.add_parser(
+        "schedule",
+        help="print the seeded arrival offsets (determinism check: same "
+             "flags, same offsets, every time)",
+    )
+    add_loadgen_flags(lgs)
+    lgs.set_defaults(fn=_cmd_loadgen_schedule)
+
+    lgr = lg_sub.add_parser(
+        "run",
+        help="drive an already-running gateway over HTTP/SSE and emit "
+             "the goodput report",
+    )
+    add_loadgen_flags(lgr)
+    lgr.add_argument("--url", required=True,
+                     help="gateway base URL, e.g. http://127.0.0.1:8080")
+    lgr.add_argument("--model", default="m",
+                     help="served model name for /v2/models/{m} paths")
+    lgr.add_argument("--prompt-lens", default="8,16,32",
+                     help="comma list of prompt lengths to mix")
+    lgr.add_argument("--output-lens", default="4,8,16",
+                     help="comma list of output budgets to mix")
+    lgr.add_argument("--max-new-tokens", type=int, default=None,
+                     help="replay: cap each request's output budget")
+    lgr.add_argument("--tenant", action="append", default=None,
+                     help="repeatable tenant spec: name=interactive,"
+                          "weight=2,priority=2,deadline_ms=30000,"
+                          "slo_ms=2000,adapter=a1")
+    lgr.add_argument("--slo-ms", type=float, default=None,
+                     help="single-tenant shorthand: accounting SLO")
+    lgr.add_argument("--deadline-ms", type=float, default=None,
+                     help="single-tenant shorthand: wire deadline header")
+    lgr.add_argument("--no-stream", action="store_true",
+                     help="use unary /generate instead of SSE streaming")
+    lgr.add_argument("--timeout", type=float, default=180.0,
+                     help="per-request client timeout")
+    lgr.add_argument("--traces-url", default=None,
+                     help="replica base URL to scrape /debug/traces from")
+    lgr.add_argument("-o", "--output", default=None,
+                     help="write the report JSON to a file")
+    lgr.set_defaults(fn=_cmd_loadgen_run)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=_cmd_version)

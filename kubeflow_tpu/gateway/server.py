@@ -60,6 +60,7 @@ from kubeflow_tpu.gateway.router import (
     ServiceRoute,
     affinity_key_of,
 )
+from kubeflow_tpu.gateway.sse import SSEFrameSplitter, sse_payload
 from kubeflow_tpu.obs.headers import (
     PREFILL_PEER_HEADER,
     RESUME_TOKENS_HEADER,
@@ -864,18 +865,10 @@ class InferenceGateway:
 
     # -- SSE passthrough + mid-stream failover ---------------------------- #
 
-    @staticmethod
-    def _sse_payload(frame: bytes) -> dict | None:
-        """The ``data:``-JSON payload of one whole SSE frame, or None for
-        anything else (comments, other event types, unparseable JSON —
-        all forwarded verbatim, never interpreted)."""
-        if not frame.startswith(b"data:"):
-            return None
-        try:
-            payload = json.loads(frame[5:].strip())
-        except ValueError:
-            return None
-        return payload if isinstance(payload, dict) else None
+    # one definition of frame-splitting + payload parsing, shared with the
+    # loadgen client (gateway/sse.py): the proxy and the harness measuring
+    # it must agree on what a whole frame is
+    _sse_payload = staticmethod(sse_payload)
 
     async def _pump_sse(
         self, upstream, resp, committed: list[int], *, rewrite: bool
@@ -897,12 +890,10 @@ class InferenceGateway:
         byte-identical passthrough."""
         import aiohttp
 
-        buf = b""
+        split = SSEFrameSplitter()
         try:
             async for chunk in upstream.content.iter_any():
-                buf += chunk
-                while b"\n\n" in buf:
-                    frame, buf = buf.split(b"\n\n", 1)
+                for frame in split.feed(chunk):
                     payload = self._sse_payload(frame)
                     if payload is None:
                         await resp.write(frame + b"\n\n")
@@ -933,8 +924,8 @@ class InferenceGateway:
                         return "done", None
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             return "died", str(e) or type(e).__name__
-        # a torn trailing half-frame in buf is DROPPED, never written —
-        # the resumed segment re-emits those tokens in a whole frame
+        # a torn trailing half-frame in split.pending is DROPPED, never
+        # written — the resumed segment re-emits those tokens whole
         return "died", "upstream EOF before terminal frame"
 
     async def _proxy_stream(

@@ -1,0 +1,61 @@
+"""Production load harness: open-loop traffic, SLO goodput, chaos overlays.
+
+The serving-plane answer to "how do we KNOW it holds up": seeded
+open-loop arrival processes (Poisson, bursty on-off, trace replay) drive
+the real :class:`~kubeflow_tpu.gateway.server.InferenceGateway` + an
+autoscaled :class:`~kubeflow_tpu.autoscale.fleet.ReplicaFleet` over
+HTTP/SSE, a reporter folds server metrics and client truth into one
+machine-readable goodput report, and chaos overlays compose the PR 3/18
+fault plans with the run timeline so every goodput dip is attributed to
+its injected window.
+
+- :mod:`arrivals` — seeded schedules: same seed, same offsets, always;
+- :mod:`workload` — prompt/output-length mixtures + per-tenant
+  deadline/priority/adapter header mixes;
+- :mod:`client` — the open-loop HTTP/SSE driver (gateway's own frame
+  splitter; client-side outcome taxonomy);
+- :mod:`reporter` — ``/metrics`` + ``/debug/traces`` → the
+  ``BENCH_*.json``-compatible report;
+- :mod:`chaos` — FaultPlan overlays armed at run offsets;
+- :mod:`harness` — the CPU-runnable end-to-end assembly behind
+  ``bench.py serving_load`` and ``kft loadgen``.
+"""
+
+from kubeflow_tpu.loadgen.arrivals import (
+    OnOffArrivals,
+    PoissonArrivals,
+    ReplayArrivals,
+    ReplayRequest,
+)
+from kubeflow_tpu.loadgen.chaos import ChaosOverlay, apply_overlay
+from kubeflow_tpu.loadgen.client import (
+    LoadClient,
+    RequestResult,
+    summarize_outcomes,
+)
+from kubeflow_tpu.loadgen.reporter import (
+    build_report,
+    goodput,
+    histogram_quantile,
+    scrape_metrics,
+)
+from kubeflow_tpu.loadgen.workload import RequestSpec, TenantSpec, WorkloadMix
+
+__all__ = [
+    "ChaosOverlay",
+    "LoadClient",
+    "OnOffArrivals",
+    "PoissonArrivals",
+    "ReplayArrivals",
+    "ReplayRequest",
+    "RequestResult",
+    "RequestSpec",
+    "TenantSpec",
+    "WorkloadMix",
+    "apply_overlay",
+    "build_report",
+    "goodput",
+    "histogram_quantile",
+    "scrape_metrics",
+    "summarize_outcomes",
+]

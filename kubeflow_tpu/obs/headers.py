@@ -60,6 +60,11 @@ RESUME_TOKENS_HEADER = "x-kft-resume-tokens"
 #: fold_in(PRNGKey(seed), absolute_position_of_t), so a resumed stream on
 #: ANY replica continues the exact sampling stream the dead replica began
 SEED_HEADER = "x-kft-seed"
+#: adapter identity (client-set, opaque): names the fine-tuned adapter a
+#: request wants served (LoRA-style multi-adapter serving). Reserved for
+#: adapter-aware routing; today it rides the wire untouched so the load
+#: harness can exercise realistic per-tenant adapter mixes end to end
+ADAPTER_HEADER = "x-kft-adapter"
 
 __all__ = [
     "DEADLINE_HEADER",
@@ -71,4 +76,5 @@ __all__ = [
     "SESSION_HEADER",
     "RESUME_TOKENS_HEADER",
     "SEED_HEADER",
+    "ADAPTER_HEADER",
 ]

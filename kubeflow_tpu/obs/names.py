@@ -113,6 +113,17 @@ AUTOSCALER_SCALE_EVENTS_TOTAL = "kft_autoscaler_scale_events_total"
 #: counter{service} — prefix-KV entries moved between replicas after a
 #: hash-ring remap (scale-up pull / scale-down evacuation)
 AUTOSCALER_KV_TRANSFERS_TOTAL = "kft_autoscaler_kv_transfers_total"
+#: gauge{service} — replicas a fleet currently runs (the actuated count,
+#: as opposed to the recommender's desired count above); the loadgen
+#: reporter reads its movement to time 1→N scale-up
+FLEET_REPLICAS = "kft_fleet_replicas"
+
+# -- load harness (loadgen/) --------------------------------------------- #
+
+#: counter{tenant,outcome} — client-side verdict on every loadgen request
+#: (completed_in_slo / completed_late / shed / error); the client-truth
+#: complement of the gateway's server-side counters
+LOADGEN_REQUESTS_TOTAL = "kft_loadgen_requests_total"
 
 # -- serving ------------------------------------------------------------ #
 

@@ -3226,6 +3226,15 @@ class LMEngineModel(LMRuntimeModel):
             row["temperature"], trace=trace, timeout_s=timeout_s, seed=seed,
         )
 
+    def _row_budget(self, row) -> int:
+        """Per-request output budget (vLLM ``max_tokens`` analog): the
+        row's requested ``max_new_tokens`` clamped to the model cap —
+        the cap bounds compiled shapes, so a request may only shrink it."""
+        req = row.get("max_new_tokens")
+        if req is None:
+            return self.max_new_tokens
+        return max(1, min(int(req), self.max_new_tokens))
+
     def _submit_row(
         self, row, deadline: float | None = None, priority: int = 0,
         trace: Any = None, peer: str | None = None,
@@ -3234,7 +3243,7 @@ class LMEngineModel(LMRuntimeModel):
         kv_span = self._pull_kv_span(row, peer, trace, deadline, seed=seed)
         toks = self.engine.submit(
             row["ids"],
-            max_new_tokens=self.max_new_tokens,
+            max_new_tokens=self._row_budget(row),
             temperature=row["temperature"],
             deadline=deadline,
             priority=priority,
@@ -3319,7 +3328,7 @@ class LMEngineModel(LMRuntimeModel):
             )
             yield from self.engine.stream(
                 row["ids"],
-                max_new_tokens=self.max_new_tokens,
+                max_new_tokens=self._row_budget(row),
                 temperature=row["temperature"],
                 deadline=deadline,
                 priority=priority,

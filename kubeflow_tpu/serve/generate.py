@@ -332,10 +332,19 @@ class LMRuntimeModel(Model):
         rows = []
         for inst in payload:
             temperature = 0.0
+            budget = None
             if isinstance(inst, str):
                 ids = self.tokenizer.encode(inst)
             elif isinstance(inst, Mapping):
                 temperature = float(inst.get("temperature", 0.0))
+                if inst.get("max_new_tokens") is not None:
+                    # per-request output budget (vLLM max_tokens analog);
+                    # engine-backed runtimes clamp it to the model cap
+                    budget = int(inst["max_new_tokens"])
+                    if budget < 1:
+                        raise ValueError(
+                            f"max_new_tokens must be >= 1, got {budget}"
+                        )
                 if isinstance(inst.get("text"), str):
                     ids = self.tokenizer.encode(inst["text"])
                 else:
@@ -345,7 +354,10 @@ class LMRuntimeModel(Model):
             ids = [int(t) % self.config.vocab_size for t in ids]
             if not ids:
                 raise ValueError("empty prompt")
-            rows.append({"ids": ids, "temperature": temperature})
+            rows.append({
+                "ids": ids, "temperature": temperature,
+                "max_new_tokens": budget,
+            })
         if not rows:
             raise ValueError("empty request")
         return rows

@@ -1130,4 +1130,57 @@ async def main():
 asyncio.run(main())
 EOF
 
+echo "== loadgen: seeded open-loop goodput 1.0, then wedged-replica dip with zero client failures =="
+python - <<'EOF'
+import asyncio
+import dataclasses
+
+from kubeflow_tpu.chaos.plan import FaultPlan, WedgeEngine
+from kubeflow_tpu.loadgen import ChaosOverlay, TenantSpec, WorkloadMix
+from kubeflow_tpu.loadgen.harness import HarnessConfig, run_serving_load
+
+# the bench recipe (bench.py serving_load), shortened: a generous WIRE
+# deadline (tight ones are unmeetable on CPU and surface as in-stream
+# errors) with a tight ACCOUNTING slo, so a wedge shows up as
+# completed_late — a goodput dip — never as a client-visible failure
+mix = WorkloadMix(
+    prompt_lens=(6, 10), output_lens=(4, 8),
+    tenants=(
+        TenantSpec("interactive", weight=2.0, priority=2,
+                   deadline_ms=30_000.0, slo_ms=2_000.0),
+        TenantSpec("batch", weight=1.0, adapter="batch-v1",
+                   slo_ms=2_000.0),
+    ),
+    vocab=80, seed=7,
+)
+steady_cfg = HarnessConfig(
+    seed=7, process="poisson", rate_rps=4.0, duration_s=7.0, mix=mix,
+    initial_replicas=2, max_replicas=2, min_replicas=2,
+)
+
+steady = asyncio.run(run_serving_load(steady_cfg))
+g = steady["goodput"]["overall"]
+assert g["offered"] > 0, steady["run"]
+assert g["error"] == 0, g
+assert g["goodput"] == 1.0, g
+# server-side histograms (PR 15), baseline-subtracted: the run's own
+# traffic must be there, not just warmup's
+ttft, tpot = steady["latency"]["ttft_ms"], steady["latency"]["tpot_ms"]
+assert ttft["count"] > 0 and ttft["p50"] is not None, ttft
+assert tpot["count"] > 0, tpot
+
+chaos_cfg = dataclasses.replace(steady_cfg, duration_s=8.0, chaos=ChaosOverlay(
+    plan=FaultPlan((WedgeEngine(model="m", hold_s=3.0),), seed=7),
+    at_s=3.0, window_s=5.0,
+))
+chaos = asyncio.run(run_serving_load(chaos_cfg))
+c = chaos["chaos"]
+assert c["faults"] == ["WedgeEngine"], c
+assert c["client_visible_failures"] == 0, c
+assert c["goodput_dip"] is not None and c["goodput_dip"] > 0, c
+print(f"loadgen OK: steady goodput={g['goodput']} over {g['offered']} "
+      f"(ttft_p50={ttft['p50']:.1f}ms n={ttft['count']}), wedge dip="
+      f"{c['goodput_dip']} in {c['window_s']}, zero client failures")
+EOF
+
 echo "smoke OK"

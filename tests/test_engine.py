@@ -123,6 +123,7 @@ def test_concurrent_staggered_requests_share_the_batch(model_and_params):
     assert eng.stats["max_concurrent"] <= 3
 
 
+@pytest.mark.slow
 def test_eos_frees_row_early(model_and_params):
     """A prompt whose continuation hits EOS quickly must finish without
     waiting for long-running neighbours."""
@@ -927,6 +928,7 @@ def test_engine_gqa_with_prefix_cache(model_and_params):
 # ------------------------------------------------- pipelined decode (carry)
 
 
+@pytest.mark.slow
 def test_pipelined_inline_token_parity_under_churn(model_and_params):
     """The tentpole contract: pipeline_depth=1 (device-resident carry +
     one-chunk-ahead dispatch) emits byte-identical token streams to the

@@ -1,0 +1,438 @@
+"""Load harness seams: seeded schedules, trace replay, SSE accounting,
+goodput reporting — every property the trajectory numbers rest on.
+
+The determinism tests ARE the contract: `bench.py serving_load` numbers
+are only comparable across commits because the same seed offers
+byte-identical traffic. The client tests run against a scripted aiohttp
+server (the HARNESS is under test here, not the gateway — the gateway
+has its own suite and the two meet in smoke.sh / the slow e2e)."""
+
+import asyncio
+import dataclasses
+import json
+
+import pytest
+
+from kubeflow_tpu.gateway.sse import SSEFrameSplitter, sse_payload
+from kubeflow_tpu.loadgen import (
+    LoadClient,
+    OnOffArrivals,
+    PoissonArrivals,
+    ReplayArrivals,
+    RequestSpec,
+    TenantSpec,
+    WorkloadMix,
+    build_report,
+    goodput,
+    histogram_quantile,
+)
+from kubeflow_tpu.obs.headers import (
+    ADAPTER_HEADER,
+    DEADLINE_HEADER,
+    PRIORITY_HEADER,
+    TENANT_HEADER,
+)
+
+
+# --------------------------------------------------------------------- #
+# arrivals: same seed, same offsets, always
+# --------------------------------------------------------------------- #
+
+def test_poisson_schedule_seed_deterministic():
+    a = PoissonArrivals(rate_rps=50.0, duration_s=2.0, seed=7).schedule()
+    b = PoissonArrivals(rate_rps=50.0, duration_s=2.0, seed=7).schedule()
+    c = PoissonArrivals(rate_rps=50.0, duration_s=2.0, seed=8).schedule()
+    assert a == b
+    assert a != c
+    assert len(a) > 0
+    assert list(a) == sorted(a)
+    assert all(0.0 <= t < 2.0 for t in a)
+    # ballpark rate sanity: ~100 expected, Poisson sd ~10
+    assert 50 < len(a) < 150
+
+
+def test_onoff_schedule_seed_deterministic_and_bursty():
+    kw = dict(base_rps=5.0, burst_rps=80.0, period_s=1.0, duration_s=4.0)
+    a = OnOffArrivals(seed=3, **kw)
+    assert a.schedule() == OnOffArrivals(seed=3, **kw).schedule()
+    assert a.schedule() != OnOffArrivals(seed=4, **kw).schedule()
+    sched = a.schedule()
+    on = [t for t in sched if (t % 1.0) < 0.5]
+    off = [t for t in sched if (t % 1.0) >= 0.5]
+    # 16x the rate in the on-window must show up as a clear majority
+    assert len(on) > 4 * max(1, len(off))
+
+
+def test_workload_plan_deterministic_prefix_stable_and_headered():
+    mix = WorkloadMix(
+        prompt_lens=(4, 8),
+        output_lens=(2, 6),
+        tenants=(
+            TenantSpec("interactive", weight=2.0, priority=2,
+                       deadline_ms=30_000.0, slo_ms=2_000.0),
+            TenantSpec("batch", weight=1.0, adapter="batch-v1"),
+        ),
+        vocab=40,
+        seed=11,
+    )
+    plan = mix.plan(24)
+    assert plan == mix.plan(24)
+    # a longer plan extends a shorter one — adding requests to a run
+    # never reshuffles the ones before them
+    assert plan[:9] == mix.plan(9)
+    assert plan != dataclasses.replace(mix, seed=12).plan(24)
+
+    tenants = {s.tenant for s in plan}
+    assert tenants == {"interactive", "batch"}
+    for s in plan:
+        assert len(s.prompt_ids) in (4, 8)
+        assert s.max_new_tokens in (2, 6)
+        assert all(2 <= t < 42 for t in s.prompt_ids)
+        h = dict(s.headers)
+        assert h[TENANT_HEADER] == s.tenant
+        if s.tenant == "interactive":
+            assert h[PRIORITY_HEADER] == "2"
+            assert h[DEADLINE_HEADER] == "30000"
+            assert s.slo_ms == 2_000.0  # accounting SLO, not the wire one
+        else:
+            assert h[ADAPTER_HEADER] == "batch-v1"
+            assert s.slo_ms is None
+
+
+# --------------------------------------------------------------------- #
+# trace replay: `kft trace dump` snapshot -> the same inter-arrival gaps
+# --------------------------------------------------------------------- #
+
+def _trace(trace_id, wall_time, duration_ms, attrs=None):
+    spans = [{"name": "gateway", "attrs": {}}]
+    if attrs is not None:
+        spans.append({"name": "engine", "attrs": attrs})
+    return {
+        "trace_id": trace_id,
+        "wall_time": wall_time,
+        "duration_ms": duration_ms,
+        "spans": spans,
+    }
+
+
+def test_replay_round_trip_reproduces_gaps_and_shapes(tmp_path):
+    # three generate traces arriving at wall 100.0, 100.4, 101.5 (arrival
+    # = wall_time - duration_ms/1e3) plus one health probe with no engine
+    # span, which replay must skip
+    snapshot = {
+        "finished": 4,
+        "traces": [
+            _trace("t-b", wall_time=100.9, duration_ms=500.0,
+                   attrs={"prompt_tokens": 8, "max_new_tokens": 6,
+                          "model": "m", "priority": 2}),
+            _trace("t-probe", wall_time=100.2, duration_ms=1.0),
+            _trace("t-a", wall_time=100.25, duration_ms=250.0,
+                   attrs={"prompt_tokens": 4, "max_new_tokens": 2,
+                          "model": "m"}),
+            _trace("t-c", wall_time=102.0, duration_ms=500.0,
+                   attrs={"prompt_tokens": 16, "max_new_tokens": 12,
+                          "model": "m", "priority": 0}),
+        ],
+    }
+    path = tmp_path / "dump.json"
+    path.write_text(json.dumps(snapshot))
+    replay = ReplayArrivals.from_file(str(path))
+
+    assert [r.trace_id for r in replay.requests] == ["t-a", "t-b", "t-c"]
+    sched = replay.schedule()
+    assert sched[0] == 0.0  # re-based to the earliest surviving arrival
+    assert sched == pytest.approx((0.0, 0.4, 1.5))
+    assert [r.prompt_tokens for r in replay.requests] == [4, 8, 16]
+    assert [r.priority for r in replay.requests] == [None, 2, 0]
+
+    mix = WorkloadMix(tenants=(TenantSpec("replayed"),), vocab=30, seed=5)
+    specs = mix.plan_for_replay(replay.requests, cap_new_tokens=8)
+    assert [len(s.prompt_ids) for s in specs] == [4, 8, 16]
+    assert [s.max_new_tokens for s in specs] == [2, 6, 8]  # 12 capped
+    assert specs == mix.plan_for_replay(replay.requests, cap_new_tokens=8)
+
+
+# --------------------------------------------------------------------- #
+# SSE framing: the one splitter both the proxy and the harness trust
+# --------------------------------------------------------------------- #
+
+def test_sse_splitter_reassembles_torn_frames_byte_by_byte():
+    frames_in = [b'data: {"token_ids": [1, 2]}', b'data: {"done": true}']
+    wire = b"\n\n".join(frames_in) + b"\n\n" + b"data: {torn..."
+    split = SSEFrameSplitter()
+    out = []
+    for i in range(len(wire)):  # worst case: one byte per chunk
+        out.extend(split.feed(wire[i:i + 1]))
+    assert out == frames_in
+    # the torn trailing half-frame stays buffered, never emitted
+    assert split.pending == b"data: {torn..."
+
+
+def test_sse_payload_ignores_non_data_frames():
+    assert sse_payload(b'data: {"done": true}') == {"done": True}
+    assert sse_payload(b": keepalive comment") is None
+    assert sse_payload(b"event: ping") is None
+    assert sse_payload(b"data: not-json{") is None
+    assert sse_payload(b"data: [1, 2]") is None  # non-dict payloads too
+
+
+# --------------------------------------------------------------------- #
+# client outcome taxonomy against a scripted server
+# --------------------------------------------------------------------- #
+
+def _spec(i, tenant, slo_ms=None):
+    return RequestSpec(
+        index=i, tenant=tenant, prompt_ids=(2, 3, 4), max_new_tokens=4,
+        headers=((TENANT_HEADER, tenant),), slo_ms=slo_ms, priority=None,
+    )
+
+
+def test_client_outcome_taxonomy_and_sse_accounting():
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    async def run():
+        async def stream(request):
+            mode = request.headers.get(TENANT_HEADER, "ok")
+            if mode == "shed503":
+                return web.Response(
+                    status=503, headers={"Retry-After": "1"},
+                    text="overloaded",
+                )
+            if mode == "shed429":
+                return web.Response(status=429, text="rate limited")
+            resp = web.StreamResponse(status=200)
+            await resp.prepare(request)
+            # first frame torn across two writes: the splitter must not
+            # account the half-frame early
+            frame1 = b'data: {"token_ids": [5, 6]}\n\n'
+            await resp.write(frame1[:9])
+            await asyncio.sleep(0.02)
+            await resp.write(frame1[9:])
+            if mode == "late":
+                await asyncio.sleep(0.08)
+            await resp.write(b'data: {"token_ids": [7]}\n\n')
+            if mode != "torn":  # torn: EOF with no terminal frame
+                await resp.write(b'data: {"done": true, "n_tokens": 3}\n\n')
+            await resp.write_eof()
+            return resp
+
+        app = web.Application()
+        app.router.add_post("/v2/models/m/generate_stream", stream)
+        srv = TestServer(app)
+        await srv.start_server()
+        try:
+            client = LoadClient(
+                f"http://127.0.0.1:{srv.port}", "m", request_timeout_s=10.0
+            )
+            specs = (
+                _spec(0, "ok", slo_ms=5_000.0),
+                _spec(1, "late", slo_ms=50.0),
+                _spec(2, "shed503"),
+                _spec(3, "shed429"),
+                _spec(4, "torn"),
+            )
+            return await client.run((0.0,) * len(specs), specs)
+        finally:
+            await srv.close()
+
+    results = asyncio.run(run())
+    by_tenant = {r.tenant: r for r in results}
+    assert by_tenant["ok"].outcome == "completed_in_slo"
+    assert by_tenant["ok"].tokens == 3
+    assert by_tenant["ok"].ttft_ms is not None
+    # TTFT waited for the WHOLE first frame, not its torn first half
+    assert by_tenant["ok"].ttft_ms >= 15.0
+    assert by_tenant["late"].outcome == "completed_late"
+    assert by_tenant["shed503"].outcome == "shed"
+    assert by_tenant["shed429"].outcome == "shed"
+    assert by_tenant["torn"].outcome == "error"
+    assert "terminal frame" in by_tenant["torn"].error
+
+    g = goodput(results)
+    assert g["offered"] == 5
+    assert g["completed_in_slo"] == 1
+    assert g["completed_late"] == 1
+    assert g["shed"] == 2
+    assert g["error"] == 1
+    assert g["goodput"] == pytest.approx(0.2)
+
+
+# --------------------------------------------------------------------- #
+# reporter: quantiles, baseline subtraction, scale-up attribution
+# --------------------------------------------------------------------- #
+
+def _prom(requests=0.0, b10=0.0, b100=0.0, binf=0.0):
+    total = binf
+    s = [
+        f'kft_gateway_requests_total{{service="m"}} {requests}',
+        f'kft_server_ttft_ms_bucket{{model="m",le="10.0"}} {b10}',
+        f'kft_server_ttft_ms_bucket{{model="m",le="100.0"}} {b100}',
+        f'kft_server_ttft_ms_bucket{{model="m",le="+Inf"}} {binf}',
+        f'kft_server_ttft_ms_count{{model="m"}} {total}',
+        f'kft_server_ttft_ms_sum{{model="m"}} {total * 8.0}',
+    ]
+    return "\n".join(s) + "\n"
+
+
+def test_reporter_baseline_subtraction_and_quantiles():
+    # warmup left 3 observations behind; the run added 8 under le=10 and
+    # 2 more in (10, 100]
+    baseline = _prom(requests=3, b10=3, b100=3, binf=3)
+    after = _prom(requests=13, b10=11, b100=13, binf=13)
+    report = build_report(
+        results=[], run={"bench": "t"},
+        gateway_metrics=after, baseline_metrics=baseline,
+    )
+    assert report["server"]["requests_total"] == 10.0
+    ttft = report["latency"]["ttft_ms"]
+    assert ttft["count"] == 10
+    # 8 of 10 subtracted observations sit in [0, 10): p50 interpolates
+    # inside the first bucket at rank 5 -> 10 * 5/8
+    assert ttft["p50"] == pytest.approx(6.25)
+    # p99 (rank 9.9) lands in (10, 100]: 10 + 90 * (9.9-8)/(13-11 -> 2)
+    assert ttft["p99"] == pytest.approx(10 + 90 * 1.9 / 2)
+
+
+def test_histogram_quantile_clamps_inf_bucket():
+    parsed = {
+        "h_bucket": [
+            ({"le": "5.0"}, 0.0),
+            ({"le": "+Inf"}, 4.0),  # every observation overflowed
+        ]
+    }
+    assert histogram_quantile(parsed, "h", 0.5) == 5.0
+
+
+def test_scale_up_latency_ignores_pre_run_events():
+    events = [
+        {"t": 90.0, "replicas": 1, "direction": "up"},    # harness setup
+        {"t": 101.0, "replicas": 2, "direction": "up"},
+        {"t": 103.5, "replicas": 1, "direction": "down"},
+    ]
+    report = build_report(
+        results=[], run={"bench": "t"},
+        fleet_events=events, run_t0=100.0,
+    )
+    auto = report["autoscale"]
+    assert auto["replicas_peak"] == 2
+    assert auto["scale_up_latency_s"] == pytest.approx(1.0)
+    assert auto["first_reached_s"] == {"2": 1.0}
+    # the timeline still shows setup events — they just don't count
+    assert [e["t_s"] for e in auto["events"]] == [-10.0, 1.0, 3.5]
+
+
+def test_chaos_window_attribution_splits_goodput():
+    def res(i, offset, outcome):
+        from kubeflow_tpu.loadgen import RequestResult
+
+        return RequestResult(
+            index=i, tenant="t", priority=None, offset_s=offset,
+            outcome=outcome,
+        )
+
+    results = [
+        res(0, 0.5, "completed_in_slo"),
+        res(1, 1.0, "completed_in_slo"),
+        res(2, 2.5, "completed_late"),   # inside [2, 4): the dip
+        res(3, 3.0, "shed"),
+        res(4, 4.5, "completed_in_slo"),
+    ]
+    report = build_report(
+        results=results, run={"bench": "t"},
+        chaos_window=(2.0, 4.0), chaos_faults=["WedgeEngine"],
+    )
+    chaos = report["chaos"]
+    assert chaos["in_window"]["offered"] == 2
+    assert chaos["in_window"]["goodput"] == 0.0
+    assert chaos["outside_window"]["goodput"] == 1.0
+    assert chaos["goodput_dip"] == pytest.approx(1.0)
+    assert chaos["client_visible_failures"] == 0
+
+
+# --------------------------------------------------------------------- #
+# CLI: the determinism contract, inspectable from the shell
+# --------------------------------------------------------------------- #
+
+def test_cli_loadgen_schedule_is_reproducible(capsys):
+    from kubeflow_tpu.cli import main
+
+    argv = ["loadgen", "schedule", "--process", "onoff", "--rate", "2",
+            "--burst-rps", "40", "--duration", "3", "--seed", "9"]
+    assert main(argv) == 0
+    first = capsys.readouterr().out
+    assert main(argv) == 0
+    assert capsys.readouterr().out == first
+    out = json.loads(first)
+    assert out["n"] == len(out["offsets_s"]) > 0
+    assert main(argv[:-1] + ["10"]) == 0
+    assert json.loads(capsys.readouterr().out)["offsets_s"] \
+        != out["offsets_s"]
+
+
+def test_cli_loadgen_run_emits_report_against_scripted_gateway(
+    tmp_path, capsys
+):
+    import threading
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    from kubeflow_tpu.cli import main
+
+    # `kft loadgen run` drives its own asyncio.run, so the scripted
+    # gateway must live on a loop that keeps running: a server thread
+    box = {}
+    started = threading.Event()
+
+    def serve():
+        async def amain():
+            async def stream(request):
+                resp = web.StreamResponse(status=200)
+                await resp.prepare(request)
+                await resp.write(b'data: {"token_ids": [9]}\n\n')
+                await resp.write(
+                    b'data: {"done": true, "n_tokens": 1}\n\n'
+                )
+                await resp.write_eof()
+                return resp
+
+            async def metrics(request):
+                return web.Response(text=_prom(requests=1, b10=1, binf=1))
+
+            app = web.Application()
+            app.router.add_post("/v2/models/m/generate_stream", stream)
+            app.router.add_get("/metrics", metrics)
+            srv = TestServer(app)
+            await srv.start_server()
+            stop = asyncio.Event()
+            box["port"] = srv.port
+            box["loop"] = asyncio.get_running_loop()
+            box["stop"] = stop
+            started.set()
+            await stop.wait()
+            await srv.close()
+
+        asyncio.run(amain())
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    assert started.wait(10)
+    try:
+        out = tmp_path / "report.json"
+        rc = main([
+            "loadgen", "run", "--url", f"http://127.0.0.1:{box['port']}",
+            "--process", "poisson", "--rate", "30", "--duration", "0.3",
+            "--seed", "3", "--slo-ms", "5000", "-o", str(out),
+        ])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        overall = report["goodput"]["overall"]
+        assert overall["offered"] > 0
+        assert overall["error"] == 0
+        assert overall["goodput"] == 1.0
+        assert report["run"]["seed"] == 3
+        assert "wrote" in capsys.readouterr().out
+    finally:
+        box["loop"].call_soon_threadsafe(box["stop"].set)
+        th.join(10)

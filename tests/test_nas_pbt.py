@@ -157,6 +157,7 @@ def test_darts_search_commits_to_architecture():
     assert cell.to_dict()["edges"]
 
 
+@pytest.mark.slow
 def test_enas_search_learns_and_derives():
     """ENAS (SURVEY.md §2.3 NAS row, the other half next to DARTS): the
     shared supernet learns through sampled paths, the REINFORCE

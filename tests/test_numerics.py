@@ -83,6 +83,7 @@ def test_metric_writer_alarm_fires_on_every_rank():
     w2.write(7, {"loss": float("nan")})  # explicit opt-out stays silent
 
 
+@pytest.mark.slow
 def test_loss_invariant_across_mesh_shapes(devices8):
     """SPMD determinism (SURVEY.md §5.2): the SAME model/seed/data must
     produce the same losses whether the 8 devices are laid out as pure DP,

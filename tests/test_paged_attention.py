@@ -226,6 +226,7 @@ def gather_streams(model_and_params, shared_prompts):
     return _run_engine(model, params, shared_prompts)
 
 
+@pytest.mark.slow
 def test_kernel_byte_parity_matrix(model_and_params, shared_prompts,
                                    gather_streams):
     """The read-path swap across the engine matrix: plain decode,

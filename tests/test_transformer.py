@@ -126,6 +126,7 @@ def test_train_fsdp_tp_sharded(devices8):
     assert mu_q.sharding.spec == q.sharding.spec
 
 
+@pytest.mark.slow
 def test_train_ring_attention_long_context(devices8):
     cfg = _cfg(n_layers=1, attn_impl="ring", attn_block_q=64, attn_block_k=64)
     _, _, history = _train(cfg, MeshSpec(data=2, seq=4), seq=256)
@@ -299,6 +300,7 @@ def test_sliding_window_config_validation():
         TransformerConfig(attn_impl="ring", attn_window=8).validate()
 
 
+@pytest.mark.slow
 def test_remat_policies_preserve_loss_and_grads(devices8):
     """remat and remat_policy='dots' trade memory for recompute — they
     must change NOTHING numerically (same loss, same grads)."""
